@@ -1,0 +1,22 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+This is the analog of the reference's local multi-process test harness
+(``subtree/rabit/tracker/rabit_demo.py``): distributed code paths are
+exercised on one host by forcing 8 virtual CPU devices.  Must run before
+jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at TPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon TPU plugin re-registers itself over JAX_PLATFORMS at import time;
+# an explicit config update is the reliable override.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

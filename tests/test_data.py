@@ -1,0 +1,152 @@
+"""DMatrix / binning tests (reference data layer semantics, SURVEY.md §2.1 L2)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from xgboost_tpu.binning import bin_dense, bin_matrix, compute_cuts
+from xgboost_tpu.data import DMatrix, parse_libsvm
+
+AGARICUS_TRAIN = "/root/reference/demo/data/agaricus.txt.train"
+
+
+def toy_libsvm(tmp_path):
+    p = tmp_path / "toy.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:-1.0\n1 0:0.5 2:3.5 3:1.0\n")
+    return str(p)
+
+
+def test_parse_libsvm(tmp_path):
+    indptr, indices, values, labels = parse_libsvm(toy_libsvm(tmp_path))
+    np.testing.assert_array_equal(labels, [1, 0, 1])
+    np.testing.assert_array_equal(indptr, [0, 2, 3, 6])
+    np.testing.assert_array_equal(indices, [0, 3, 1, 0, 2, 3])
+    np.testing.assert_allclose(values, [1.5, 2.0, -1.0, 0.5, 3.5, 1.0])
+
+
+def test_parse_libsvm_split_loading(tmp_path):
+    path = toy_libsvm(tmp_path)
+    i0, _, _, l0 = parse_libsvm(path, rank=0, nparts=2)
+    i1, _, _, l1 = parse_libsvm(path, rank=1, nparts=2)
+    assert len(l0) + len(l1) == 3
+    np.testing.assert_array_equal(l0, [1, 1])
+    np.testing.assert_array_equal(l1, [0])
+
+
+def test_dmatrix_from_file(tmp_path):
+    dm = DMatrix(toy_libsvm(tmp_path))
+    assert dm.num_row == 3
+    assert dm.num_col == 4
+    np.testing.assert_array_equal(dm.get_label(), [1, 0, 1])
+
+
+def test_dmatrix_from_dense_missing_nan():
+    X = np.array([[1.0, np.nan], [np.nan, 2.0]], dtype=np.float32)
+    dm = DMatrix(X, label=[0, 1])
+    assert dm.num_row == 2 and dm.num_col == 2
+    rows, vals = dm.column_values(0)
+    np.testing.assert_array_equal(rows, [0])
+    np.testing.assert_allclose(vals, [1.0])
+
+
+def test_dmatrix_from_dense_missing_value():
+    X = np.array([[1.0, -999.0], [3.0, 2.0]], dtype=np.float32)
+    dm = DMatrix(X, missing=-999.0)
+    d = dm.to_dense()
+    assert np.isnan(d[0, 1])
+    assert d[1, 1] == 2.0
+
+
+def test_dmatrix_slice():
+    X = np.arange(12, dtype=np.float32).reshape(4, 3)
+    dm = DMatrix(X, label=[0, 1, 2, 3], weight=[1, 2, 3, 4])
+    s = dm.slice([2, 0])
+    assert s.num_row == 2
+    np.testing.assert_array_equal(s.get_label(), [2, 0])
+    np.testing.assert_array_equal(s.get_weight(), [3, 1])
+    np.testing.assert_allclose(s.to_dense()[0], X[2])
+
+
+def test_dmatrix_save_load_binary(tmp_path):
+    X = np.random.RandomState(0).rand(10, 5).astype(np.float32)
+    dm = DMatrix(X, label=np.arange(10), weight=np.ones(10))
+    path = str(tmp_path / "m.npz")
+    dm.save_binary(path)
+    dm2 = DMatrix.load_binary(path)
+    np.testing.assert_allclose(dm2.to_dense(), dm.to_dense())
+    np.testing.assert_array_equal(dm2.get_label(), dm.get_label())
+
+
+def test_cache_uri(tmp_path):
+    path = toy_libsvm(tmp_path)
+    cache = str(tmp_path / "c")
+    dm = DMatrix(path + "#" + cache)
+    assert os.path.exists(cache + ".npz")
+    dm2 = DMatrix(path + "#" + cache)  # loads from cache
+    np.testing.assert_array_equal(dm2.get_label(), dm.get_label())
+
+
+def test_group_sidecar(tmp_path):
+    path = toy_libsvm(tmp_path)
+    with open(path + ".group", "w") as f:
+        f.write("2\n1\n")
+    dm = DMatrix(path)
+    np.testing.assert_array_equal(dm.info.group_ptr, [0, 2, 3])
+
+
+def test_set_group():
+    dm = DMatrix(np.zeros((5, 2), dtype=np.float32) + 1)
+    dm.set_group([2, 3])
+    np.testing.assert_array_equal(dm.info.group_ptr, [0, 2, 5])
+
+
+# ---------------------------------------------------------------- binning
+
+def test_binning_roundtrip_dense():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4).astype(np.float32)
+    dm = DMatrix(X)
+    cuts = compute_cuts(dm, max_bin=32)
+    B = bin_matrix(dm, cuts)
+    assert B.dtype == np.uint8
+    assert B.shape == (500, 4)
+    assert B.min() >= 1  # no missing in dense data
+    # bin order preserves value order per feature
+    f = 2
+    order = np.argsort(X[:, f])
+    assert np.all(np.diff(B[order, f].astype(int)) >= 0)
+    # binning a dense matrix directly agrees with the CSR path
+    np.testing.assert_array_equal(bin_dense(X, cuts), B)
+
+
+def test_binning_missing_bin_zero():
+    X = np.array([[1.0, np.nan], [2.0, 5.0], [3.0, 6.0]], dtype=np.float32)
+    dm = DMatrix(X)
+    cuts = compute_cuts(dm, max_bin=8)
+    B = bin_matrix(dm, cuts)
+    assert B[0, 1] == 0  # missing
+    assert B[1, 1] >= 1
+
+
+def test_binning_agaricus_binary_features():
+    dm = DMatrix(AGARICUS_TRAIN)
+    cuts = compute_cuts(dm, max_bin=256)
+    B = bin_matrix(dm, cuts)
+    assert B.shape[0] == 6513
+    # agaricus is one-hot: present entries are all 1.0 and map to one bin
+    # above the min-cut; absent entries are missing (bin 0)
+    assert set(np.unique(B)) <= {0, 2}
+
+
+def test_split_semantics_match_binning():
+    # split at cut j: left iff v < cuts[j] iff bin <= j+1
+    X = np.array([[0.0], [1.0], [2.0], [3.0]], dtype=np.float32)
+    dm = DMatrix(X)
+    cuts = compute_cuts(dm, max_bin=8)
+    B = bin_matrix(dm, cuts)
+    for j in range(cuts.n_cuts[0]):
+        thr = cuts.cut_values[0, j]
+        left_by_value = X[:, 0] < thr
+        left_by_bin = B[:, 0] <= j + 1
+        np.testing.assert_array_equal(left_by_value, left_by_bin)

@@ -1,0 +1,158 @@
+"""Objective coverage: multiclass, gblinear, LambdaRank (reference demo
+configs: multiclass_classification, rank, generalized_linear_model)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def make_multiclass(n=1200, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6).astype(np.float32)
+    logits = X[:, :k] + 0.3 * rng.randn(n, k)
+    y = np.argmax(logits, axis=1).astype(np.float32)
+    return X, y
+
+
+def test_multi_softmax():
+    X, y = make_multiclass()
+    dtrain = xgb.DMatrix(X[:900], label=y[:900])
+    dtest = xgb.DMatrix(X[900:], label=y[900:])
+    params = {"objective": "multi:softmax", "num_class": 4, "max_depth": 4,
+              "eta": 0.3}
+    res = {}
+    bst = xgb.train(params, dtrain, 15, evals=[(dtest, "test")],
+                    evals_result=res, verbose_eval=False)
+    assert res["test-merror"][-1] < 0.25
+    preds = bst.predict(dtest)
+    assert preds.shape == (300,)
+    assert set(np.unique(preds)) <= {0.0, 1.0, 2.0, 3.0}
+    err = np.mean(preds != y[900:])
+    assert abs(err - res["test-merror"][-1]) < 1e-6
+
+
+def test_multi_softprob():
+    X, y = make_multiclass()
+    dtrain = xgb.DMatrix(X, label=y)
+    params = {"objective": "multi:softprob", "num_class": 4, "max_depth": 3}
+    bst = xgb.train(params, dtrain, 5, verbose_eval=False)
+    preds = bst.predict(dtrain)
+    assert preds.shape == (1200, 4)
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-5)
+    # mlogloss metric runs on multiclass output
+    line = bst.eval_set([(dtrain, "train")], 0)
+    assert "train-merror" in line
+
+
+def test_multiclass_mlogloss_decreases():
+    X, y = make_multiclass()
+    dtrain = xgb.DMatrix(X, label=y)
+    res = {}
+    xgb.train({"objective": "multi:softprob", "num_class": 4, "max_depth": 4,
+               "eval_metric": "mlogloss"}, dtrain, 10,
+              evals=[(dtrain, "train")], evals_result=res, verbose_eval=False)
+    ll = res["train-mlogloss"]
+    assert ll[-1] < ll[0] * 0.5
+
+
+# ---------------------------------------------------------------- gblinear
+
+def test_gblinear_regression():
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 8).astype(np.float32)
+    true_w = np.array([1.5, -2.0, 0.0, 0.5, 0.0, 3.0, 0.0, -1.0],
+                      dtype=np.float32)
+    y = X @ true_w + 0.05 * rng.randn(2000).astype(np.float32)
+    dtrain = xgb.DMatrix(X, label=y)
+    params = {"booster": "gblinear", "objective": "reg:linear", "eta": 0.5,
+              "lambda": 0.1, "base_score": 0.0}
+    res = {}
+    bst = xgb.train(params, dtrain, 60, evals=[(dtrain, "train")],
+                    evals_result=res, verbose_eval=False)
+    assert res["train-rmse"][-1] < 0.2
+    # recovered weights close to truth
+    w = np.asarray(bst.gbtree.weight)[:, 0]
+    np.testing.assert_allclose(w, true_w, atol=0.15)
+
+
+def test_gblinear_l1_sparsity():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1000, 10).astype(np.float32)
+    y = (2.0 * X[:, 0]).astype(np.float32)
+    dtrain = xgb.DMatrix(X, label=y)
+    params = {"booster": "gblinear", "objective": "reg:linear", "eta": 0.5,
+              "alpha": 5.0, "lambda": 0.0, "base_score": 0.0}
+    bst = xgb.train(params, dtrain, 40, verbose_eval=False)
+    w = np.asarray(bst.gbtree.weight)[:, 0]
+    # L1 should zero out the 9 irrelevant features
+    assert np.sum(np.abs(w[1:]) < 0.05) >= 8
+    assert abs(w[0]) > 1.0
+
+
+def test_gblinear_binary_and_save_load(tmp_path):
+    rng = np.random.RandomState(2)
+    X = rng.randn(800, 5).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    dtrain = xgb.DMatrix(X, label=y)
+    params = {"booster": "gblinear", "objective": "binary:logistic",
+              "eta": 0.8, "lambda": 0.01}
+    bst = xgb.train(params, dtrain, 30, verbose_eval=False)
+    preds = bst.predict(dtrain)
+    assert np.mean((preds > 0.5) == (y == 1)) > 0.9
+    path = str(tmp_path / "lin.model")
+    bst.save_model(path)
+    bst2 = xgb.Booster(model_file=path)
+    np.testing.assert_allclose(bst2.predict(dtrain), preds, rtol=1e-6)
+    dump = bst2.get_dump()[0]
+    assert dump.startswith("bias:")
+
+
+# ----------------------------------------------------------------- ranking
+
+def make_ranking(n_groups=60, group_size=12, seed=0):
+    rng = np.random.RandomState(seed)
+    X, y, groups = [], [], []
+    for _ in range(n_groups):
+        Xi = rng.randn(group_size, 5).astype(np.float32)
+        score = Xi[:, 0] * 2 + Xi[:, 1]
+        # graded relevance 0..2 by within-group rank
+        order = np.argsort(score)
+        rel = np.zeros(group_size, dtype=np.float32)
+        rel[order[-2:]] = 2.0
+        rel[order[-5:-2]] = 1.0
+        X.append(Xi)
+        y.append(rel)
+        groups.append(group_size)
+    return np.concatenate(X), np.concatenate(y), np.array(groups)
+
+
+@pytest.mark.parametrize("objective,metric", [
+    ("rank:pairwise", "map"),
+    ("rank:ndcg", "ndcg"),
+    ("rank:map", "map"),
+])
+def test_ranking_objectives(objective, metric):
+    X, y, groups = make_ranking()
+    dtrain = xgb.DMatrix(X, label=y, group=groups)
+    params = {"objective": objective, "max_depth": 3, "eta": 0.3,
+              "min_child_weight": 0.1}
+    res = {}
+    xgb.train(params, dtrain, 15, evals=[(dtrain, "train")],
+              evals_result=res, verbose_eval=False)
+    key = f"train-{metric}"
+    assert res[key][-1] > 0.85, res[key]
+    assert res[key][-1] > res[key][0]
+
+
+def test_ranking_metrics_at_n():
+    X, y, groups = make_ranking(seed=3)
+    dtrain = xgb.DMatrix(X, label=y, group=groups)
+    params = {"objective": "rank:pairwise", "max_depth": 3,
+              "min_child_weight": 0.1,
+              "eval_metric": ["ndcg@5", "map@3", "pre@2"]}
+    res = {}
+    xgb.train(params, dtrain, 10, evals=[(dtrain, "train")],
+              evals_result=res, verbose_eval=False)
+    assert res["train-ndcg@5"][-1] > 0.8
+    assert res["train-pre@2"][-1] > 0.8

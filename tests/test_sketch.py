@@ -1,0 +1,117 @@
+"""Quantile sketch tests — semantics of reference src/utils/quantile.h.
+
+The reference's own in-code checker is WQSummary::CheckValid
+(quantile.h:165-173); these tests enforce the same invariants plus the
+rank-error guarantee eps * total_weight after merge/prune chains.
+"""
+
+import numpy as np
+import pytest
+
+from xgboost_tpu.sketch import (empty_summary, make_summary, merge_summaries,
+                                propose_cuts, prune_summary, query_quantile,
+                                sketch_column)
+
+
+def exact_rank(values, weights, v):
+    """(rmin, rmax) of value v in the exact weighted order."""
+    below = weights[values < v].sum()
+    at = weights[values == v].sum()
+    return below, below + at
+
+
+def test_make_summary_exact():
+    rng = np.random.RandomState(0)
+    vals = rng.randint(0, 50, size=1000).astype(np.float64)
+    w = rng.rand(1000)
+    s = make_summary(vals, w)
+    s.check_valid()
+    assert s.total_weight == pytest.approx(w.sum())
+    for v in np.unique(vals)[::7]:
+        rmin, rmax = exact_rank(vals, w, v)
+        i = np.searchsorted(s.value, v)
+        assert s.rmin[i] == pytest.approx(rmin)
+        assert s.rmax[i] == pytest.approx(rmax)
+
+
+def test_merge_matches_concatenation():
+    rng = np.random.RandomState(1)
+    a_vals, b_vals = rng.randn(300), rng.randn(400)
+    a_w, b_w = rng.rand(300), rng.rand(400)
+    merged = merge_summaries(make_summary(a_vals, a_w), make_summary(b_vals, b_w))
+    direct = make_summary(np.concatenate([a_vals, b_vals]),
+                          np.concatenate([a_w, b_w]))
+    merged.check_valid()
+    np.testing.assert_allclose(merged.value, direct.value)
+    np.testing.assert_allclose(merged.rmin, direct.rmin, atol=1e-9)
+    np.testing.assert_allclose(merged.rmax, direct.rmax, atol=1e-9)
+    np.testing.assert_allclose(merged.wmin, direct.wmin, atol=1e-9)
+
+
+def test_merge_with_duplicate_values_across_sides():
+    a = make_summary(np.array([1.0, 2.0, 3.0]), np.array([1.0, 1.0, 1.0]))
+    b = make_summary(np.array([2.0, 3.0, 4.0]), np.array([2.0, 2.0, 2.0]))
+    m = merge_summaries(a, b)
+    m.check_valid()
+    direct = make_summary(np.array([1., 2., 3., 2., 3., 4.]),
+                          np.array([1., 1., 1., 2., 2., 2.]))
+    np.testing.assert_allclose(m.value, direct.value)
+    np.testing.assert_allclose(m.rmax, direct.rmax)
+
+
+def test_merge_empty():
+    a = make_summary(np.arange(5.0), None)
+    assert merge_summaries(a, empty_summary()) is a
+    assert merge_summaries(empty_summary(), a) is a
+
+
+def test_prune_bounds_rank_error():
+    rng = np.random.RandomState(2)
+    vals = rng.randn(20000)
+    w = np.ones(20000)
+    s = make_summary(vals, w)
+    maxsize = 64
+    p = prune_summary(s, maxsize)
+    p.check_valid()
+    assert p.size <= maxsize
+    # rank error bound ~ 2 * total / maxsize (GK-style guarantee)
+    assert p.max_error() <= 2.5 * s.total_weight / (maxsize - 2)
+    # extremes preserved
+    assert p.value[0] == s.value[0]
+    assert p.value[-1] == s.value[-1]
+
+
+def test_sketch_column_chunked_matches_eps():
+    rng = np.random.RandomState(3)
+    vals = rng.randn(50000)
+    eps = 0.05
+    s = sketch_column(vals, None, eps, chunk=7000)
+    s.check_valid()
+    assert s.size <= int(2.0 / eps)
+    # query median within eps*N of the truth
+    med = query_quantile(s, len(vals) / 2)
+    true_rank = (vals < med).sum()
+    assert abs(true_rank - len(vals) / 2) < 3 * eps * len(vals)
+
+
+def test_propose_cuts_quantiles():
+    vals = np.arange(10000, dtype=np.float64)
+    s = make_summary(vals, None)
+    cuts = propose_cuts(prune_summary(s, 300), 10)
+    assert len(cuts) <= 9
+    assert np.all(np.diff(cuts) > 0)
+    # roughly even mass per bin
+    bins = np.searchsorted(cuts, vals, side="right")
+    counts = np.bincount(bins)
+    assert counts.min() > 0.3 * len(vals) / (len(cuts) + 1)
+
+
+def test_propose_cuts_few_distinct():
+    # binary feature: every distinct value is a cut (the cut at the minimum
+    # enables missing-vs-present splits on sparse indicator features)
+    vals = np.array([0.0] * 50 + [1.0] * 50)
+    cuts = propose_cuts(make_summary(vals, None), 256)
+    assert list(cuts) == [0.0, 1.0]
+    # split semantics at cut 1.0: v=0 goes left, v=1 goes right
+    assert np.searchsorted(cuts, 0.0, side="right") <= 1
+    assert np.searchsorted(cuts, 1.0, side="right") == 2

@@ -1,0 +1,164 @@
+"""End-to-end training tests — the reference's demo configs as integration
+tests (SURVEY.md §4: demo/binary_classification mushroom.conf, regression,
+custom objective path)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+AGARICUS_TRAIN = "/root/reference/demo/data/agaricus.txt.train"
+AGARICUS_TEST = "/root/reference/demo/data/agaricus.txt.test"
+
+
+@pytest.fixture(scope="module")
+def agaricus():
+    dtrain = xgb.DMatrix(AGARICUS_TRAIN)
+    dtest = xgb.DMatrix(AGARICUS_TEST, num_col=dtrain.num_col)
+    return dtrain, dtest
+
+
+def test_agaricus_mushroom_conf(agaricus):
+    """Reference demo/binary_classification/mushroom.conf: eta=1.0,
+    max_depth=3, 2 rounds, binary:logistic -> train error ~0.0141,
+    test error ~0.0162 (printed by the reference demo)."""
+    dtrain, dtest = agaricus
+    params = {"eta": 1.0, "max_depth": 3, "objective": "binary:logistic",
+              "eval_metric": "error"}
+    res = {}
+    bst = xgb.train(params, dtrain, 2, evals=[(dtrain, "train"),
+                                              (dtest, "test")],
+                    evals_result=res, verbose_eval=False)
+    assert res["train-error"][-1] < 0.02
+    assert res["test-error"][-1] < 0.02
+    preds = bst.predict(dtest)
+    assert preds.shape == (dtest.num_row,)
+    assert preds.min() >= 0.0 and preds.max() <= 1.0
+    err = np.mean((preds > 0.5) != (dtest.get_label() == 1))
+    assert err < 0.02
+
+
+def test_agaricus_deeper_converges(agaricus):
+    dtrain, dtest = agaricus
+    params = {"eta": 0.3, "max_depth": 6, "objective": "binary:logistic"}
+    res = {}
+    xgb.train(params, dtrain, 10, evals=[(dtest, "test")], evals_result=res,
+              verbose_eval=False)
+    assert res["test-error"][-1] < 0.005  # agaricus is nearly separable
+
+
+def test_regression_squared_error():
+    rng = np.random.RandomState(0)
+    X = rng.rand(2000, 5).astype(np.float32)
+    y = (3 * X[:, 0] + np.sin(5 * X[:, 1]) + 0.1 * rng.randn(2000)).astype(
+        np.float32)
+    dtrain = xgb.DMatrix(X[:1500], label=y[:1500])
+    dtest = xgb.DMatrix(X[1500:], label=y[1500:])
+    params = {"objective": "reg:linear", "max_depth": 4, "eta": 0.3,
+              "base_score": 0.5}
+    res = {}
+    xgb.train(params, dtrain, 40, evals=[(dtest, "test")], evals_result=res,
+              verbose_eval=False)
+    # residual noise floor is 0.1; a working booster gets close
+    assert res["test-rmse"][-1] < 0.25
+    assert res["test-rmse"][-1] < res["test-rmse"][0] * 0.3
+
+
+def test_eval_line_format(agaricus):
+    dtrain, dtest = agaricus
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3},
+                    dtrain, 1, verbose_eval=False)
+    line = bst.eval_set([(dtrain, "train"), (dtest, "eval")], 7)
+    assert line.startswith("[7]\ttrain-error:")
+    assert "\teval-error:" in line
+
+
+def test_predict_margin_vs_transform(agaricus):
+    dtrain, _ = agaricus
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3},
+                    dtrain, 2, verbose_eval=False)
+    margin = bst.predict(dtrain, output_margin=True)
+    prob = bst.predict(dtrain)
+    np.testing.assert_allclose(prob, 1 / (1 + np.exp(-margin)), rtol=1e-5)
+
+
+def test_custom_objective(agaricus):
+    """Custom obj path == reference Booster.boost / XGBoosterBoostOneIter
+    (demo/guide-python/custom_objective.py)."""
+    dtrain, dtest = agaricus
+
+    def logregobj(preds, dtrain):
+        labels = dtrain.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - labels, p * (1.0 - p)
+
+    def evalerror(preds, dmat):
+        labels = dmat.get_label()
+        return "error", float(np.mean((preds > 0.0) != (labels == 1)))
+
+    params = {"max_depth": 2, "eta": 1.0, "objective": "binary:logitraw"}
+    res = {}
+    xgb.train(params, dtrain, 3, evals=[(dtest, "test")], obj=logregobj,
+              feval=evalerror, evals_result=res, verbose_eval=False)
+    assert res["test-error"][-1] < 0.05
+
+
+def test_early_stopping(agaricus):
+    dtrain, dtest = agaricus
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 1.0,
+              "eval_metric": "logloss"}
+    bst = xgb.train(params, dtrain, 50, evals=[(dtest, "test")],
+                    early_stopping_rounds=3, verbose_eval=False)
+    assert bst.best_iteration >= 0
+    assert bst.best_score < 0.1
+
+
+def test_weights_affect_training():
+    rng = np.random.RandomState(1)
+    X = rng.rand(500, 3).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    w = np.where(y == 1, 10.0, 0.1).astype(np.float32)
+    dtrain = xgb.DMatrix(X, label=y, weight=w)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 2},
+                    dtrain, 5, verbose_eval=False)
+    preds = bst.predict(dtrain)
+    # heavily weighted positives should be predicted confidently
+    assert preds[y == 1].mean() > 0.8
+
+
+def test_base_margin(agaricus):
+    """boost_from_prediction demo: margin continuation must equal training
+    longer (demo/guide-python/boost_from_prediction.py)."""
+    dtrain, _ = agaricus
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.5}
+    bst1 = xgb.train(params, dtrain, 4, verbose_eval=False)
+    m1 = bst1.predict(dtrain, output_margin=True)
+
+    bst_a = xgb.train(params, dtrain, 2, verbose_eval=False)
+    ptrain = bst_a.predict(dtrain, output_margin=True)
+    dtrain2 = xgb.DMatrix(AGARICUS_TRAIN)
+    dtrain2.set_base_margin(ptrain)
+    bst_b = xgb.train(params, dtrain2, 2, verbose_eval=False)
+    m2 = bst_b.predict(dtrain2, output_margin=True)
+    # same data/params: two-stage margins should be very close to one-shot
+    assert np.abs(m1 - m2).mean() < 0.5
+
+
+def test_subsample_colsample(agaricus):
+    dtrain, dtest = agaricus
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.5,
+              "subsample": 0.7, "colsample_bytree": 0.7,
+              "colsample_bylevel": 0.8, "seed": 3}
+    res = {}
+    xgb.train(params, dtrain, 8, evals=[(dtest, "test")], evals_result=res,
+              verbose_eval=False)
+    assert res["test-error"][-1] < 0.05
+
+
+def test_determinism(agaricus):
+    dtrain, _ = agaricus
+    params = {"objective": "binary:logistic", "max_depth": 4, "subsample": 0.8,
+              "seed": 7}
+    p1 = xgb.train(params, dtrain, 3, verbose_eval=False).predict(dtrain)
+    p2 = xgb.train(params, dtrain, 3, verbose_eval=False).predict(dtrain)
+    np.testing.assert_array_equal(p1, p2)

@@ -1,0 +1,28 @@
+"""xgboost_tpu — a TPU-native gradient boosting framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of early
+XGBoost (reference: mu-bu/xgboost): gbtree + gblinear boosters, the full
+objective/metric set, histogram tree learning driven by a distributed
+weighted quantile sketch, and row-sharded data-parallel training where
+the reference's rabit TCP allreduce becomes ``psum`` over an ICI mesh.
+
+Design stance (see SURVEY.md §7): not a port.  Data is pre-binned into
+dense device arrays (uint8 bin ids) instead of CSR/CSC scans; trees are
+struct-of-arrays tensors grown level-by-level inside ``jit``; the one
+custom kernel is a Pallas histogram kernel; everything else is XLA.
+"""
+
+from xgboost_tpu.config import TrainParam
+from xgboost_tpu.data import DMatrix
+from xgboost_tpu.learner import Booster, train, cv
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "TrainParam",
+    "DMatrix",
+    "Booster",
+    "train",
+    "cv",
+    "__version__",
+]

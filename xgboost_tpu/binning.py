@@ -1,0 +1,115 @@
+"""Quantize a DMatrix into dense bin-id device arrays.
+
+This is the TPU-native representational shift (SURVEY.md §7): instead of
+the reference's CSR/CSC sorted-column scans
+(``src/tree/updater_colmaker-inl.hpp:362-414``), data is quantized ONCE
+per training run using the weighted quantile sketch and stored as a dense
+``(n_rows, n_features)`` array of small-int bin ids in HBM.  All tree
+growth then operates on bins (histogram method — the reference's own
+scalable path, ``learner-inl.hpp:91-97``).
+
+Binning scheme:
+  - bin 0 is reserved for MISSING (absent CSR entries — the reference's
+    missing-value semantics with learned default direction,
+    ``model.h:555-566``).
+  - a present value v maps to bin ``1 + searchsorted(cuts_f, v, 'right')``.
+  - a split at cut index j of feature f sends rows left iff ``v < cuts_f[j]``
+    ⇔ ``bin(v) <= j + 1``; missing rows follow the learned default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from xgboost_tpu.data import DMatrix
+from xgboost_tpu.sketch import (QuantileSummary, make_summary, prune_summary,
+                                propose_cuts, sketch_column)
+
+
+@dataclasses.dataclass
+class CutMatrix:
+    """Per-feature cut points, padded to a rectangle for device use.
+
+    cut_values[f, j] for j < n_cuts[f] are strictly increasing; padding is
+    +inf (so searchsorted against the padded row is still correct).
+    """
+
+    cut_values: np.ndarray  # (F, max_cuts) float32, +inf padded
+    n_cuts: np.ndarray      # (F,) int32
+
+    @property
+    def num_feature(self) -> int:
+        return self.cut_values.shape[0]
+
+    @property
+    def max_bin(self) -> int:
+        # value bins 1..max_cuts+1 plus missing bin 0
+        return self.cut_values.shape[1] + 2
+
+
+def compute_cuts(dmat: DMatrix, max_bin: int = 256, sketch_eps: float = 0.03,
+                 sketch_ratio: float = 2.0,
+                 hess_weights: Optional[np.ndarray] = None) -> CutMatrix:
+    """Propose cut points for every feature via the weighted quantile sketch.
+
+    Replaces the reference's per-round distributed sketch + cut proposal
+    (``updater_histmaker-inl.hpp:353-462``) with one global pass; the
+    summary machinery (merge/prune bounds) is identical.
+    """
+    F = dmat.num_col
+    per_feature = []
+    max_cuts = 1
+    for f in range(F):
+        rows, vals = dmat.column_values(f)
+        w = None if hess_weights is None else hess_weights[rows]
+        if len(vals) > (1 << 16):
+            summary = sketch_column(vals, w, sketch_eps, sketch_ratio)
+        else:
+            summary = prune_summary(
+                make_summary(vals, w),
+                max(2, int(sketch_ratio / max(sketch_eps, 1.0 / max_bin))))
+        cuts = propose_cuts(summary, max_bin - 1)  # leave room for missing bin
+        per_feature.append(cuts)
+        max_cuts = max(max_cuts, len(cuts))
+    cut_values = np.full((F, max_cuts), np.inf, dtype=np.float32)
+    n_cuts = np.zeros(F, dtype=np.int32)
+    for f, cuts in enumerate(per_feature):
+        cut_values[f, :len(cuts)] = cuts
+        n_cuts[f] = len(cuts)
+    return CutMatrix(cut_values, n_cuts)
+
+
+def bin_matrix(dmat: DMatrix, cuts: CutMatrix) -> np.ndarray:
+    """Quantize to a dense (n_rows, F) bin-id array (0 = missing)."""
+    n, F = dmat.num_row, cuts.num_feature
+    dtype = np.uint8 if cuts.max_bin <= 256 else np.uint16
+    out = np.zeros((n, F), dtype=dtype)
+    rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(dmat.indptr))
+    cols = dmat.indices
+    in_range = cols < F
+    rows, cols, vals = rows[in_range], cols[in_range], dmat.values[in_range]
+    for f in range(F):
+        m = cols == f
+        if not m.any():
+            continue
+        b = 1 + np.searchsorted(cuts.cut_values[f, :cuts.n_cuts[f]],
+                                vals[m], side="right")
+        out[rows[m], f] = b.astype(dtype)
+    return out
+
+
+def bin_dense(X: np.ndarray, cuts: CutMatrix, missing: float = np.nan) -> np.ndarray:
+    """Quantize a dense float matrix directly (prediction-time fast path)."""
+    n, F = X.shape
+    dtype = np.uint8 if cuts.max_bin <= 256 else np.uint16
+    out = np.zeros((n, F), dtype=dtype)
+    for f in range(min(F, cuts.num_feature)):
+        col = X[:, f]
+        present = ~np.isnan(col) if np.isnan(missing) else col != missing
+        b = 1 + np.searchsorted(cuts.cut_values[f, :cuts.n_cuts[f]],
+                                col[present], side="right")
+        out[present, f] = b.astype(dtype)
+    return out

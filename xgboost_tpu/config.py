@@ -1,0 +1,170 @@
+"""Typed configuration for xgboost_tpu.
+
+The reference flows every parameter as string ``(name, value)`` pairs
+through ``SetParam`` cascades (reference ``src/learner/learner-inl.hpp:79-124``,
+``src/tree/param.h:15-107``).  Here the canonical store is one typed
+dataclass; the string-pair ingestion surface (CLI ``k=v``, Python dicts)
+is kept for parity, including the reference's alias table
+(eta/learning_rate, gamma/min_split_loss, lambda/reg_lambda,
+alpha/reg_alpha — reference ``src/tree/param.h:79-107``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+# accepted alias -> dataclass field name (reference param.h SetParam)
+_ALIASES: Dict[str, str] = {
+    "learning_rate": "eta",
+    "min_split_loss": "gamma",
+    "lambda": "reg_lambda",
+    "alpha": "reg_alpha",
+    "gbm": "booster",  # CLI uses 'gbm'; wrapper/xgboost.py uses 'booster'
+}
+
+
+def canonical_name(name: str) -> str:
+    return _ALIASES.get(name, name)
+
+
+@dataclasses.dataclass
+class TrainParam:
+    """All training hyperparameters.
+
+    Tree params mirror reference ``src/tree/param.h:15-107``; learner
+    params mirror ``src/learner/learner-inl.hpp:427-454``; gblinear
+    params mirror ``src/gbm/gblinear-inl.hpp:196-226``.
+    """
+
+    # -- tree booster params (reference src/tree/param.h) --
+    eta: float = 0.3
+    gamma: float = 0.0  # min_split_loss
+    max_depth: int = 6
+    min_child_weight: float = 1.0
+    reg_lambda: float = 1.0
+    reg_alpha: float = 0.0
+    max_delta_step: float = 0.0
+    subsample: float = 1.0
+    colsample_bytree: float = 1.0
+    colsample_bylevel: float = 1.0
+    default_direction: int = 0  # 0=learn, 1=left, 2=right
+    sketch_eps: float = 0.03
+    sketch_ratio: float = 2.0
+    # TPU-native binning: number of histogram bins (incl. reserved missing
+    # bin 0).  The reference's analog is max_sketch_size=sketch_ratio/sketch_eps.
+    max_bin: int = 256
+
+    # -- gbtree params (reference src/gbm/gbtree-inl.hpp:389-428) --
+    num_parallel_tree: int = 1
+    updater: str = "grow_histmaker,prune"
+
+    # -- learner params (reference src/learner/learner-inl.hpp) --
+    booster: str = "gbtree"  # gbtree | gblinear
+    objective: str = "reg:linear"
+    base_score: float = 0.5
+    num_class: int = 0
+    scale_pos_weight: float = 1.0
+    eval_metric: Tuple[str, ...] = ()
+    seed: int = 0
+    seed_per_iteration: bool = False
+    dsplit: str = "auto"  # auto | row | col
+    nthread: int = 0
+    silent: int = 0
+
+    # -- gblinear params (reference src/gbm/gblinear-inl.hpp) --
+    lambda_bias: float = 0.0
+
+    # -- ranking objective params (reference src/learner/objective-inl.hpp:283-300)
+    num_pairsample: int = 1
+    fix_list_weight: float = 0.0
+
+    # unknown/extra params are preserved (the reference tolerates and
+    # forwards unrecognized names through SetParam cascades)
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def field_names(cls) -> List[str]:
+        return [f.name for f in dataclasses.fields(cls) if f.name != "extras"]
+
+    def set_param(self, name: str, value: Any) -> "TrainParam":
+        """Set one parameter (string values are coerced), returning self."""
+        name = canonical_name(name)
+        if name == "eval_metric":
+            # repeated eval_metric appends, like the reference EvalSet
+            if isinstance(value, str):
+                value = (*self.eval_metric, value)
+            else:
+                value = tuple(value)
+            self.eval_metric = value
+            return self
+        if name == "default_direction" and isinstance(value, str):
+            value = {"learn": 0, "left": 1, "right": 2}.get(value, value)
+        if name in self.field_names():
+            ftype = {f.name: f.type for f in dataclasses.fields(self)}[name]
+            setattr(self, name, _coerce(value, ftype, getattr(self, name)))
+        else:
+            self.extras[name] = value
+        return self
+
+    @classmethod
+    def from_dict(cls, params: Optional[Dict[str, Any]]) -> "TrainParam":
+        p = cls()
+        for k, v in (params or {}).items():
+            p.set_param(k, v)
+        return p
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {k: getattr(self, k) for k in self.field_names()}
+        d["eval_metric"] = list(self.eval_metric)
+        d.update(self.extras)
+        return d
+
+    # number of output groups (trees per boosting round for gbtree)
+    @property
+    def num_output_group(self) -> int:
+        return max(1, self.num_class)
+
+
+def _coerce(value: Any, ftype: Any, current: Any) -> Any:
+    """Coerce a (possibly string) value to the dataclass field's type."""
+    target = type(current) if current is not None else str
+    if isinstance(ftype, str):
+        ftype = ftype.strip()
+    if isinstance(value, str):
+        if target is bool:
+            return value.lower() in ("1", "true", "yes")
+        if target is int:
+            return int(float(value))
+        if target is float:
+            return float(value)
+        return value
+    if target is bool:
+        return bool(value)
+    if target is int:
+        return int(value)
+    if target is float:
+        return float(value)
+    return value
+
+
+def parse_config_file(path: str) -> List[Tuple[str, str]]:
+    """Parse a ``name = value`` config file.
+
+    Mirrors the reference's ConfigIterator (``src/utils/config.h``): one
+    ``name = value`` pair per line, ``#`` comments, quoted strings allowed.
+    Returns pairs in file order (later pairs override earlier on apply).
+    """
+    pairs: List[Tuple[str, str]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            name, value = line.split("=", 1)
+            name = name.strip()
+            value = value.strip().strip('"').strip("'")
+            if name:
+                pairs.append((name, value))
+    return pairs

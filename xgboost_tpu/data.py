@@ -1,0 +1,287 @@
+"""DMatrix: data container for xgboost_tpu.
+
+Covers the reference's data layer (SURVEY.md §2.1 L2):
+  - ``MetaInfo`` — labels/weights/groups/base_margin/root_index/fold_index
+    (reference ``src/learner/dmatrix.h:18-145``), including sidecar file
+    loading (``train.txt.group`` etc., ``dmatrix.h:108-137``).
+  - CSR storage + libsvm text parsing with optional rank/npart split
+    loading for distributed training (reference
+    ``src/io/simple_dmatrix-inl.hpp:69-117``).
+  - binary save/load cache (reference magic 0xffffab01,
+    ``simple_dmatrix-inl.hpp:154-251``) — here an ``.npz`` container, with
+    the same ``path#cachefile`` / auto ``.buffer`` conventions handled in
+    :mod:`xgboost_tpu.io.dispatch`.
+  - ``slice``/``mknfold`` support (reference ``wrapper/xgboost_wrapper.cpp:200-245``).
+
+TPU-native difference: downstream training never iterates CSR — the
+matrix is quantized once into a dense (n_rows, n_features) bin-id array
+(:mod:`xgboost_tpu.binning`), the analog of the reference's decision to
+route all distributed/external training through histogram updaters
+(``learner-inl.hpp:91-97,263-267``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+class MetaInfo:
+    """Per-row (and per-group) metadata (reference src/learner/dmatrix.h:18-145)."""
+
+    __slots__ = ("label", "weight", "group_ptr", "base_margin",
+                 "root_index", "fold_index")
+
+    def __init__(self):
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.group_ptr: Optional[np.ndarray] = None  # (n_groups+1,) int
+        self.base_margin: Optional[np.ndarray] = None
+        self.root_index: Optional[np.ndarray] = None
+        self.fold_index: Optional[np.ndarray] = None
+
+    def get_weight(self, n_rows: int) -> np.ndarray:
+        if self.weight is None:
+            return np.ones(n_rows, dtype=np.float32)
+        return self.weight
+
+    def set_field(self, name: str, value) -> None:
+        if value is None:
+            setattr(self, name if name != "group" else "group_ptr", None)
+            return
+        arr = np.asarray(value)
+        if name == "group":
+            # group sizes -> cumulative pointer (reference MetaInfo::SetInfo)
+            self.group_ptr = np.concatenate(
+                [[0], np.cumsum(arr.astype(np.int64))])
+        elif name in ("label", "weight", "base_margin"):
+            setattr(self, name, arr.astype(np.float32).ravel())
+        elif name in ("root_index", "fold_index"):
+            setattr(self, name, arr.astype(np.int32).ravel())
+        else:
+            raise ValueError(f"unknown meta field {name!r}")
+
+    def get_field(self, name: str):
+        if name == "group":
+            return self.group_ptr
+        return getattr(self, name)
+
+    def slice(self, rindex: np.ndarray) -> "MetaInfo":
+        out = MetaInfo()
+        for f in ("label", "weight", "base_margin", "root_index", "fold_index"):
+            v = getattr(self, f)
+            if v is not None:
+                setattr(out, f, v[rindex])
+        # group structure does not survive arbitrary row slicing (same as
+        # reference XGDMatrixSliceDMatrix, which drops group_ptr)
+        return out
+
+
+class DMatrix:
+    """Sparse (CSR) data matrix with metadata.
+
+    Accepts: libsvm text path, dense numpy array (with ``missing`` marker),
+    scipy CSR/CSC, or a (indptr, indices, values, num_col) CSR tuple.
+    """
+
+    def __init__(self, data: Any, label=None, weight=None, missing: float = np.nan,
+                 base_margin=None, group=None, num_col: Optional[int] = None,
+                 silent: bool = True, feature_names: Optional[Sequence[str]] = None):
+        self.info = MetaInfo()
+        self.feature_names = list(feature_names) if feature_names else None
+        self._col_cache = None
+
+        if isinstance(data, str):
+            from xgboost_tpu.io.dispatch import load_dmatrix_into
+            load_dmatrix_into(self, data, silent=silent)
+        elif isinstance(data, tuple) and len(data) == 4:
+            self.indptr, self.indices, self.values, self._num_col = data
+            self.indptr = np.asarray(self.indptr, dtype=np.int64)
+            self.indices = np.asarray(self.indices, dtype=np.int32)
+            self.values = np.asarray(self.values, dtype=np.float32)
+        elif _is_scipy_sparse(data):
+            csr = data.tocsr()
+            self.indptr = csr.indptr.astype(np.int64)
+            self.indices = csr.indices.astype(np.int32)
+            self.values = csr.data.astype(np.float32)
+            self._num_col = csr.shape[1]
+        else:
+            arr = np.asarray(data, dtype=np.float32)
+            if arr.ndim != 2:
+                raise ValueError("expected 2D array")
+            self._from_dense(arr, missing)
+
+        if num_col is not None:
+            self._num_col = max(num_col, getattr(self, "_num_col", 0))
+        elif not hasattr(self, "_num_col") or self._num_col is None:
+            self._num_col = int(self.indices.max()) + 1 if len(self.indices) else 0
+
+        if label is not None:
+            self.info.set_field("label", label)
+        if weight is not None:
+            self.info.set_field("weight", weight)
+        if base_margin is not None:
+            self.info.set_field("base_margin", base_margin)
+        if group is not None:
+            self.info.set_field("group", group)
+
+    # ------------------------------------------------------------------
+    def _from_dense(self, arr: np.ndarray, missing: float) -> None:
+        if np.isnan(missing):
+            present = ~np.isnan(arr)
+        else:
+            present = arr != missing
+        counts = present.sum(axis=1)
+        self.indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        rows, cols = np.nonzero(present)
+        self.indices = cols.astype(np.int32)
+        self.values = arr[rows, cols].astype(np.float32)
+        self._num_col = arr.shape[1]
+
+    # ------------------------------------------------------------------
+    @property
+    def num_row(self) -> int:
+        return len(self.indptr) - 1
+
+    @property
+    def num_col(self) -> int:
+        return self._num_col
+
+    def set_label(self, label):
+        self.info.set_field("label", label)
+
+    def set_weight(self, weight):
+        self.info.set_field("weight", weight)
+
+    def set_group(self, group):
+        self.info.set_field("group", group)
+
+    def set_base_margin(self, margin):
+        self.info.set_field("base_margin", margin)
+
+    def get_label(self):
+        return self.info.label
+
+    def get_weight(self):
+        return self.info.get_weight(self.num_row)
+
+    def get_base_margin(self):
+        return self.info.base_margin
+
+    # ------------------------------------------------------------------
+    def column_values(self, col: int):
+        """(row_ids, values) of one column — used by sketch/binning and
+        gblinear (the reference's ColBatch access, src/data.h:92-118)."""
+        if self._col_cache is None:
+            order = np.argsort(self.indices, kind="stable")
+            sorted_cols = self.indices[order]
+            starts = np.searchsorted(sorted_cols, np.arange(self._num_col + 1))
+            row_of_entry = np.repeat(np.arange(self.num_row, dtype=np.int64),
+                                     np.diff(self.indptr))
+            self._col_cache = (order, starts, row_of_entry)
+        order, starts, row_of_entry = self._col_cache
+        sel = order[starts[col]:starts[col + 1]]
+        return row_of_entry[sel], self.values[sel]
+
+    def to_dense(self, missing: float = np.nan) -> np.ndarray:
+        out = np.full((self.num_row, self._num_col), missing, dtype=np.float32)
+        rows = np.repeat(np.arange(self.num_row), np.diff(self.indptr))
+        out[rows, self.indices] = self.values
+        return out
+
+    def slice(self, rindex) -> "DMatrix":
+        """Row-slice (reference XGDMatrixSliceDMatrix, xgboost_wrapper.cpp:200-245)."""
+        rindex = np.asarray(rindex, dtype=np.int64)
+        counts = np.diff(self.indptr)[rindex]
+        new_indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        sel = np.concatenate(
+            [np.arange(self.indptr[r], self.indptr[r + 1]) for r in rindex]
+        ) if len(rindex) else np.zeros(0, dtype=np.int64)
+        out = DMatrix((new_indptr, self.indices[sel], self.values[sel],
+                       self._num_col))
+        out.info = self.info.slice(rindex)
+        out.feature_names = self.feature_names
+        return out
+
+    # ------------------------------------------------------------------
+    def save_binary(self, path: str, silent: bool = True) -> None:
+        """Binary cache (the reference's 0xffffab01 .buffer format,
+        simple_dmatrix-inl.hpp:154-251 — here an npz container)."""
+        fields = {"indptr": self.indptr, "indices": self.indices,
+                  "values": self.values,
+                  "num_col": np.int64(self._num_col)}
+        for f in ("label", "weight", "base_margin", "root_index", "fold_index"):
+            v = getattr(self.info, f)
+            if v is not None:
+                fields["meta_" + f] = v
+        if self.info.group_ptr is not None:
+            fields["meta_group_ptr"] = self.info.group_ptr
+        np.savez(path, **fields)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "DMatrix":
+        with np.load(path) as z:
+            dm = cls((z["indptr"], z["indices"], z["values"],
+                      int(z["num_col"])))
+            for f in ("label", "weight", "base_margin", "root_index",
+                      "fold_index"):
+                if "meta_" + f in z:
+                    setattr(dm.info, f, z["meta_" + f])
+            if "meta_group_ptr" in z:
+                dm.info.group_ptr = z["meta_group_ptr"]
+        return dm
+
+
+def _is_scipy_sparse(data) -> bool:
+    try:
+        import scipy.sparse as sp  # noqa: deferred optional dependency
+        return sp.issparse(data)
+    except ImportError:
+        return False
+
+
+# ----------------------------------------------------------------------
+def parse_libsvm(path: str, rank: int = 0, nparts: int = 1):
+    """Parse libsvm text into CSR; optional round-robin row sharding.
+
+    The reference splits a text source across workers at load time
+    (``simple_dmatrix-inl.hpp:89-96``); here ``rank``/``nparts`` select a
+    contiguous byte-range-free row shard (row i kept iff i % nparts == rank).
+    Returns (indptr, indices, values, labels).
+    """
+    labels = []
+    indptr = [0]
+    indices: list = []
+    values: list = []
+    with open(path, "rb") as f:
+        for i, raw in enumerate(f):
+            if nparts > 1 and i % nparts != rank:
+                continue
+            parts = raw.split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                k, _, v = tok.partition(b":")
+                indices.append(int(k))
+                values.append(float(v))
+            indptr.append(len(indices))
+    return (np.asarray(indptr, dtype=np.int64),
+            np.asarray(indices, dtype=np.int32),
+            np.asarray(values, dtype=np.float32),
+            np.asarray(labels, dtype=np.float32))
+
+
+def load_meta_sidecars(dmat: DMatrix, path: str) -> None:
+    """Load ``path.group`` / ``path.weight`` / ``path.base_margin`` sidecar
+    files if present (reference MetaInfo::TryLoadGroup/TryLoadFloatInfo,
+    src/learner/dmatrix.h:108-137)."""
+    if os.path.exists(path + ".group"):
+        dmat.info.set_field(
+            "group", np.loadtxt(path + ".group", dtype=np.int64, ndmin=1))
+    for name in ("weight", "base_margin"):
+        if os.path.exists(path + "." + name):
+            dmat.info.set_field(
+                name, np.loadtxt(path + "." + name, dtype=np.float32, ndmin=1))

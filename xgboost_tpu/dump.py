@@ -1,0 +1,99 @@
+"""Model text dump + feature importance.
+
+Follows the reference dump format (``src/tree/model.h:403-458``):
+``nid:[fX<cond] yes=L,no=R,missing=M`` with tab indentation per depth,
+optional ``,gain=..,cover=..`` stats, and feature-map typed names
+(``src/utils/fmap.h``: i=indicator, q=quantitative, int=integer).
+Node ids here are heap-order (children of g are 2g+1/2g+2) rather than
+the reference's allocation order; structure and semantics match.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def load_fmap(path: str) -> Dict[int, tuple]:
+    """Parse a featmap.txt: ``<fid>\\t<name>\\t<type>`` per line."""
+    out: Dict[int, tuple] = {}
+    if not path:
+        return out
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 3:
+                out[int(parts[0])] = (parts[1], parts[2])
+    return out
+
+
+def dump_trees(booster, fmap: str = "", with_stats: bool = False) -> List[str]:
+    if booster.param.booster == "gblinear":
+        return [booster.gbtree.dump_text()]
+    fmap_d = load_fmap(fmap)
+    out = []
+    for tree in booster.gbtree.trees:
+        feature = np.asarray(tree.feature)
+        thr = np.asarray(tree.threshold)
+        default_left = np.asarray(tree.default_left)
+        is_leaf = np.asarray(tree.is_leaf)
+        leaf_value = np.asarray(tree.leaf_value)
+        gain = np.asarray(tree.gain)
+        cover = np.asarray(tree.sum_hess)
+        lines: List[str] = []
+
+        def rec(nid: int, depth: int):
+            indent = "\t" * depth
+            f = feature[nid]
+            if is_leaf[nid] or f < 0:
+                s = f"{indent}{nid}:leaf={leaf_value[nid]:g}"
+                if with_stats:
+                    s += f",cover={cover[nid]:g}"
+                lines.append(s)
+                return
+            left, right = 2 * nid + 1, 2 * nid + 2
+            miss = left if default_left[nid] else right
+            if f in fmap_d:
+                name, ftype = fmap_d[f]
+                if ftype == "i":
+                    cond = f"{name}"
+                    # indicator: split is presence/absence; missing side is 'no'
+                    yes, no = (right, left) if default_left[nid] else (left, right)
+                    s = (f"{indent}{nid}:[{cond}] yes={yes},no={no},"
+                         f"missing={miss}")
+                elif ftype == "int":
+                    s = (f"{indent}{nid}:[{name}<{int(np.ceil(thr[nid]))}] "
+                         f"yes={left},no={right},missing={miss}")
+                else:
+                    s = (f"{indent}{nid}:[{name}<{thr[nid]:g}] "
+                         f"yes={left},no={right},missing={miss}")
+            else:
+                s = (f"{indent}{nid}:[f{f}<{thr[nid]:g}] "
+                     f"yes={left},no={right},missing={miss}")
+            if with_stats:
+                s += f",gain={gain[nid]:g},cover={cover[nid]:g}"
+            lines.append(s)
+            rec(left, depth + 1)
+            rec(right, depth + 1)
+
+        rec(0, 0)
+        out.append("\n".join(lines) + "\n")
+    return out
+
+
+def feature_importance(booster, fmap: str = "") -> Dict[str, int]:
+    """Split-count importance (reference get_fscore, wrapper/xgboost.py:512-530)."""
+    fmap_d = load_fmap(fmap)
+    counts: Dict[str, int] = {}
+    for tree in booster.gbtree.trees:
+        feature = np.asarray(tree.feature)
+        is_leaf = np.asarray(tree.is_leaf)
+        sum_hess = np.asarray(tree.sum_hess)
+        for nid in range(len(feature)):
+            f = feature[nid]
+            # a real (reachable) split node: has a feature and mass
+            if f >= 0 and not is_leaf[nid] and sum_hess[nid] > 0:
+                name = fmap_d.get(f, (f"f{f}", "q"))[0]
+                counts[name] = counts.get(name, 0) + 1
+    return counts

@@ -1,0 +1,58 @@
+"""Data loading dispatcher.
+
+Mirrors the reference IO dispatcher (``src/io/io.cpp:13-92``):
+``path#cachefile`` suffix parsing, binary-cache sniffing, per-rank cache
+names in distributed mode, and sidecar metadata loading.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def load_dmatrix_into(dmat, uri: str, silent: bool = True,
+                      rank: int = 0, nparts: int = 1) -> None:
+    """Populate `dmat` (a DMatrix) from a URI.
+
+    Supported forms (reference io.cpp:20-29):
+      - ``file.txt``              — libsvm text
+      - ``file.txt#cache``        — libsvm text with binary cache file
+      - ``file.npz``              — saved binary DMatrix
+    """
+    from xgboost_tpu.data import parse_libsvm, load_meta_sidecars
+
+    path, _, cache = uri.partition("#")
+    if nparts > 1 and cache:
+        cache = f"{cache}.r{rank}-{nparts}"  # per-rank cache (io.cpp:56-61)
+
+    cache_file = cache + ".npz" if cache else None
+    if cache_file and os.path.exists(cache_file):
+        _copy_from(dmat, _load_npz(cache_file))
+        return
+    if path.endswith(".npz") and os.path.exists(path):
+        _copy_from(dmat, _load_npz(path))
+        return
+
+    indptr, indices, values, labels = parse_libsvm(path, rank, nparts)
+    dmat.indptr, dmat.indices, dmat.values = indptr, indices, values
+    dmat._num_col = int(indices.max()) + 1 if len(indices) else 0
+    dmat.info.set_field("label", labels)
+    load_meta_sidecars(dmat, path)
+    if cache_file:
+        dmat.save_binary(cache_file[:-len(".npz")] + ".npz")
+    if not silent:
+        print(f"{len(labels)}x{dmat._num_col} matrix with {len(values)} "
+              f"entries loaded from {uri}")
+
+
+def _load_npz(path: str):
+    from xgboost_tpu.data import DMatrix
+    return DMatrix.load_binary(path)
+
+
+def _copy_from(dst, src) -> None:
+    dst.indptr, dst.indices, dst.values = src.indptr, src.indices, src.values
+    dst._num_col = src._num_col
+    dst.info = src.info

@@ -1,0 +1,551 @@
+"""BoostLearner: objective + booster + metrics orchestration, and the
+``train``/``cv`` front-end API.
+
+Mirrors the reference's learner layer (``src/learner/learner-inl.hpp``:
+``BoostLearner::UpdateOneIter/EvalOneIter/Predict`` :274-346) and the
+Python surface (``wrapper/xgboost.py``: ``Booster`` :246-530, ``train``
+with early stopping :533-632, ``cv``/``mknfold``/``aggcv`` :635-740).
+
+Prediction caching: each DMatrix a Booster has seen keeps a device-side
+binned matrix and a running margin, advanced incrementally per round —
+the reference's pred_buffer/pred_counter design
+(``gbtree-inl.hpp:304-353``) without the per-row tree walk.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xgboost_tpu.binning import bin_matrix, compute_cuts
+from xgboost_tpu.config import TrainParam
+from xgboost_tpu.data import DMatrix, MetaInfo
+from xgboost_tpu.metrics import create_metric
+from xgboost_tpu.objectives import create_objective
+
+_MAGIC = "xgbtpu001"
+
+
+class _CacheEntry:
+    """Per-DMatrix device state (the reference's CacheEntry,
+    learner-inl.hpp:495-512)."""
+
+    def __init__(self, dmat: DMatrix, binned: jax.Array, base_margin: jax.Array,
+                 info=None, row_valid: Optional[jax.Array] = None,
+                 n_real: Optional[int] = None):
+        self.dmat = dmat                 # strong ref: id(dmat) keys the cache
+        self.binned = binned
+        self.base = base_margin          # (N_pad, K)
+        self.info = info if info is not None else dmat.info
+        self.row_valid = row_valid       # None, or (N_pad,) bool when padded
+        self.n_real = n_real if n_real is not None else dmat.num_row
+        self.margin: Optional[jax.Array] = None
+        self.applied = 0                 # trees folded into margin
+
+
+class Booster:
+    """Learner handle (reference wrapper/xgboost.py Booster + BoostLearner)."""
+
+    def __init__(self, params: Optional[dict] = None,
+                 cache: Sequence[DMatrix] = (), model_file: Optional[str] = None):
+        self.param = TrainParam.from_dict(params or {})
+        self.obj = None
+        self.gbtree = None
+        self.num_feature = 0
+        self._cache: Dict[int, _CacheEntry] = {}
+        self.best_iteration: int = -1
+        self.best_score: float = float("nan")
+        self.attributes: Dict[str, str] = {}
+        self._mesh = None                  # resolved at _lazy_init (dsplit=row)
+        self._pending_cache = list(cache)  # bound at _lazy_init (needs cuts)
+        if model_file is not None:
+            self.load_model(model_file)
+
+    # ----------------------------------------------------------- parameters
+    def set_param(self, name, value=None):
+        if isinstance(name, dict):
+            for k, v in name.items():
+                self.param.set_param(k, v)
+        else:
+            self.param.set_param(name, value)
+        self._reconfigure()
+
+    def _reconfigure(self):
+        """Propagate changed params into live objective/booster state, so
+        continued training (xgb_model=...) honors new hyperparameters."""
+        if self.obj is not None:
+            self.obj = create_objective(self.param.objective)
+            self.obj.set_param("scale_pos_weight", self.param.scale_pos_weight)
+            self.obj.set_param("num_class", self.param.num_class)
+            self.obj.set_param("num_pairsample", self.param.num_pairsample)
+            self.obj.set_param("fix_list_weight", self.param.fix_list_weight)
+        if self.gbtree is not None and self.param.booster != "gblinear":
+            from xgboost_tpu.models.gbtree import make_grow_config
+            self.gbtree.param = self.param
+            self.gbtree.cfg = make_grow_config(self.param,
+                                               self.gbtree.cuts.max_bin)
+
+    # ------------------------------------------------------------- init
+    def _lazy_init(self, dtrain: DMatrix):
+        if self.obj is None:
+            self.obj = create_objective(self.param.objective)
+            self.obj.set_param("scale_pos_weight", self.param.scale_pos_weight)
+            self.obj.set_param("num_class", self.param.num_class)
+            self.obj.set_param("num_pairsample", self.param.num_pairsample)
+            self.obj.set_param("fix_list_weight", self.param.fix_list_weight)
+        if self.gbtree is None:
+            if self.param.booster == "gblinear":
+                from xgboost_tpu.models.gblinear import GBLinear
+                self.num_feature = dtrain.num_col
+                self.gbtree = GBLinear(self.param, dtrain.num_col)
+            else:
+                from xgboost_tpu.models.gbtree import GBTree
+                self.num_feature = dtrain.num_col
+                cuts = compute_cuts(dtrain, self.param.max_bin,
+                                    self.param.sketch_eps,
+                                    self.param.sketch_ratio)
+                self.gbtree = GBTree(self.param, cuts)
+        if self.param.dsplit == "row" and self._mesh is None \
+                and self.param.booster != "gblinear":
+            from xgboost_tpu.parallel import mesh as pmesh
+            self._mesh = pmesh.get_mesh() or pmesh.data_parallel_mesh()
+        for d in self._pending_cache:
+            self._entry(d)
+        self._pending_cache = []
+
+    @property
+    def _K(self) -> int:
+        return max(1, self.param.num_output_group)
+
+    def _base_margin_of(self, dmat: DMatrix, n: int) -> jax.Array:
+        bm = dmat.info.base_margin
+        if bm is not None:
+            return jnp.asarray(np.asarray(bm, np.float32).reshape(n, self._K))
+        base = self.obj.prob_to_margin(self.param.base_score)
+        return jnp.full((n, self._K), base, jnp.float32)
+
+    def _entry(self, dmat: DMatrix) -> _CacheEntry:
+        key = id(dmat)
+        if key not in self._cache:
+            if self.num_feature and dmat.num_col > self.num_feature:
+                raise ValueError(
+                    f"data has {dmat.num_col} features, model was trained "
+                    f"with {self.num_feature}")
+            if self.param.booster == "gblinear":
+                binned = self.gbtree.device_matrix(dmat)
+                self._cache[key] = _CacheEntry(
+                    dmat, binned, self._base_margin_of(dmat, dmat.num_row))
+            elif self._mesh is not None:
+                self._cache[key] = self._make_sharded_entry(dmat)
+            else:
+                binned = jnp.asarray(bin_matrix(dmat, self.gbtree.cuts))
+                self._cache[key] = _CacheEntry(
+                    dmat, binned, self._base_margin_of(dmat, dmat.num_row))
+        return self._cache[key]
+
+    def _make_sharded_entry(self, dmat: DMatrix) -> _CacheEntry:
+        """Pad rows to the mesh size and shard over the 'data' axis (the
+        reference's per-rank row-shard loading, simple_dmatrix-inl.hpp:89-96,
+        realized as device placement under one controller)."""
+        from xgboost_tpu.parallel.dp import shard_rows
+        n = dmat.num_row
+        pad = (-n) % self._mesh.size
+        binned_np = bin_matrix(dmat, self.gbtree.cuts)
+        if pad:
+            binned_np = np.pad(binned_np, ((0, pad), (0, 0)))
+        binned = shard_rows(self._mesh, jnp.asarray(binned_np))
+        row_valid = shard_rows(self._mesh, jnp.asarray(
+            np.arange(n + pad) < n))
+        info = _pad_info(dmat.info, n, pad)
+        base = np.broadcast_to(
+            np.asarray(self._base_margin_of(dmat, n)), (n, self._K))
+        base = np.concatenate(
+            [base, np.zeros((pad, self._K), np.float32)]) if pad else base
+        base = shard_rows(self._mesh, jnp.asarray(base, jnp.float32))
+        return _CacheEntry(dmat, binned, base, info=info,
+                           row_valid=row_valid, n_real=n)
+
+    def _sync_margin(self, entry: _CacheEntry):
+        """Fold not-yet-applied trees into the cached margin, one round's
+        worth at a time (fixed shapes -> one compilation)."""
+        if entry.margin is None:
+            entry.margin = jnp.broadcast_to(
+                entry.base, (entry.binned.shape[0], self._K)).astype(jnp.float32)
+        if self.param.booster == "gblinear":
+            entry.margin = self.gbtree.predict_margin(entry.binned, entry.base)
+            entry.applied = self.gbtree.version
+            return
+        per_round = self._K * max(1, self.param.num_parallel_tree)
+        while entry.applied < self.gbtree.num_trees:
+            chunk = self.gbtree.trees[entry.applied:entry.applied + per_round]
+            first_group = self.gbtree.tree_group[entry.applied]
+            entry.margin = self.gbtree.predict_incremental(
+                entry.binned, entry.margin, chunk, first_group)
+            entry.applied += len(chunk)
+
+    # ------------------------------------------------------------- training
+    def update(self, dtrain: DMatrix, iteration: int, fobj=None):
+        """One boosting round (reference BoostLearner::UpdateOneIter,
+        learner-inl.hpp:274-281; custom-objective path Booster.update,
+        wrapper/xgboost.py:335-355)."""
+        self._lazy_init(dtrain)
+        entry = self._entry(dtrain)
+        self._sync_margin(entry)
+        if fobj is None:
+            gh = self.obj.get_gradient(entry.margin, entry.info, iteration,
+                                       entry.binned.shape[0])
+        else:
+            pred = np.asarray(self.obj.pred_transform(entry.margin))
+            if pred.shape[1] == 1:
+                pred = pred[:, 0]
+            grad, hess = fobj(pred, dtrain)
+            return self.boost(dtrain, grad, hess)
+        self._do_boost(dtrain, entry, gh, iteration)
+
+    def boost(self, dtrain: DMatrix, grad, hess):
+        """Boost from user-supplied gradients (reference
+        XGBoosterBoostOneIter, wrapper/xgboost_wrapper.cpp:310-317)."""
+        self._lazy_init(dtrain)
+        entry = self._entry(dtrain)
+        self._sync_margin(entry)
+        g = np.asarray(grad, np.float32).reshape(dtrain.num_row, self._K)
+        h = np.asarray(hess, np.float32).reshape(dtrain.num_row, self._K)
+        gh = jnp.stack([jnp.asarray(g), jnp.asarray(h)], axis=-1)
+        self._do_boost(dtrain, entry, gh, self.gbtree.num_boosted_rounds
+                       if self.param.booster != "gblinear"
+                       else self.gbtree.version)
+
+    def _do_boost(self, dtrain, entry, gh, iteration):
+        # deterministic per-iteration seeding: the reference forces
+        # seed_per_iteration in distributed mode for replayable recovery
+        # (learner-inl.hpp:275-277); fold_in gives that always.
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.param.seed), iteration)
+        if self.param.booster == "gblinear":
+            self.gbtree.do_boost(entry.binned, gh, dtrain.info)
+            entry.applied = self.gbtree.version  # recompute on next sync
+            entry.margin = None
+            self._sync_margin(entry)
+            return
+        _, delta = self.gbtree.do_boost(entry.binned, gh, key,
+                                        row_valid=entry.row_valid,
+                                        mesh=self._mesh)
+        entry.margin = entry.margin + delta
+        entry.applied = self.gbtree.num_trees
+
+    # ------------------------------------------------------------ inference
+    def predict(self, data: DMatrix, output_margin: bool = False,
+                ntree_limit: int = 0, pred_leaf: bool = False) -> np.ndarray:
+        """(reference BoostLearner::Predict, learner-inl.hpp:332-346 and
+        Booster.predict, wrapper/xgboost.py:422-450)."""
+        assert self.gbtree is not None, "model not trained/loaded"
+        cached = self._cache.get(id(data))
+        if cached is None:
+            # one-off prediction: no cache registration (the reference's
+            # buffer_offset = -1 path, learner-inl.hpp:332-346)
+            if self.num_feature and data.num_col > self.num_feature:
+                raise ValueError(
+                    f"data has {data.num_col} features, model was trained "
+                    f"with {self.num_feature}")
+            if self.param.booster == "gblinear":
+                binned = self.gbtree.device_matrix(data)
+            else:
+                binned = jnp.asarray(bin_matrix(data, self.gbtree.cuts))
+            base = self._base_margin_of(data, data.num_row)
+        else:
+            binned, base = cached.binned, cached.base
+        if pred_leaf:
+            return np.asarray(self.gbtree.predict_leaf(binned, ntree_limit))
+        if cached is not None and ntree_limit == 0:
+            self._sync_margin(cached)
+            margin = cached.margin
+        else:
+            margin = self.gbtree.predict_margin(binned, base, ntree_limit)
+        out = self.obj.pred_transform(margin, output_margin=output_margin)
+        out = np.asarray(out)
+        if cached is not None:
+            out = out[:cached.n_real]
+        if out.ndim == 2 and out.shape[1] == 1:
+            out = out[:, 0]
+        return out
+
+    # ----------------------------------------------------------- evaluation
+    def _metrics(self, feval=None) -> List:
+        names = list(self.param.eval_metric)
+        if not names and feval is None:
+            names = [self.obj.default_metric]
+        return [create_metric(n) for n in names]
+
+    def eval_set(self, evals: Sequence[Tuple[DMatrix, str]], iteration: int = 0,
+                 feval=None) -> str:
+        """Formatted eval line (reference EvalSet::Eval, evaluation.h:62-95:
+        ``[iter]\\tname-metric:value``)."""
+        parts = [f"[{iteration}]"]
+        for dmat, name in evals:
+            entry = self._entry(dmat)
+            self._sync_margin(entry)
+            tr = np.asarray(self.obj.eval_transform(entry.margin))[:entry.n_real]
+            labels = np.asarray(dmat.get_label())
+            weights = np.asarray(dmat.get_weight())
+            gptr = dmat.info.group_ptr
+            for m in self._metrics(feval):
+                p = tr if tr.shape[1] > 1 else tr[:, 0]
+                val = m(p, labels, weights, gptr)
+                parts.append(f"{name}-{m.metric_name}:{val:.6f}")
+            if feval is not None:
+                # feval comes LAST so early stopping tracks it (reference
+                # wrapper/xgboost.py appends custom eval after built-ins)
+                preds = tr[:, 0] if tr.shape[1] == 1 else tr
+                mname, val = feval(preds, dmat)
+                parts.append(f"{name}-{mname}:{val:.6f}")
+        return "\t".join(parts)
+
+    def eval(self, data: DMatrix, name: str = "eval", iteration: int = 0) -> str:
+        return self.eval_set([(data, name)], iteration)
+
+    # ---------------------------------------------------------- model store
+    def save_model(self, path: str):
+        assert self.gbtree is not None, "nothing to save"
+        header = {
+            "magic": _MAGIC,
+            "param": _jsonable(self.param.to_dict()),
+            "objective": self.param.objective,
+            "booster": self.param.booster,
+            "num_feature": self.num_feature,
+            "attributes": self.attributes,
+            "best_iteration": self.best_iteration,
+        }
+        state = self.gbtree.get_state()
+        with open(path, "wb") as f:
+            np.savez(f, header=np.frombuffer(
+                json.dumps(header).encode(), dtype=np.uint8), **state)
+
+    def load_model(self, path: str):
+        try:
+            z = np.load(path, allow_pickle=False)
+        except Exception as e:
+            raise ValueError(f"{path} is not an xgboost_tpu model file: {e}")
+        with z:
+            header = json.loads(bytes(z["header"]).decode())
+            assert header.get("magic") == _MAGIC, "not an xgboost_tpu model"
+            self.param = TrainParam.from_dict(header["param"])
+            self.num_feature = header["num_feature"]
+            self.attributes = header.get("attributes", {})
+            self.best_iteration = header.get("best_iteration", -1)
+            state = {k: z[k] for k in z.files if k != "header"}
+        self.obj = create_objective(self.param.objective)
+        self.obj.set_param("num_class", self.param.num_class)
+        if self.param.booster == "gblinear":
+            from xgboost_tpu.models.gblinear import GBLinear
+            self.gbtree = GBLinear.from_state(self.param, state)
+        else:
+            from xgboost_tpu.models.gbtree import GBTree
+            self.gbtree = GBTree.from_state(self.param, state)
+        self._cache.clear()
+
+    def save_raw(self) -> bytes:
+        import io
+        buf = io.BytesIO()
+        header = {"magic": _MAGIC, "param": _jsonable(self.param.to_dict()),
+                  "num_feature": self.num_feature,
+                  "attributes": self.attributes,
+                  "best_iteration": self.best_iteration}
+        np.savez(buf, header=np.frombuffer(
+            json.dumps(header).encode(), dtype=np.uint8),
+            **self.gbtree.get_state())
+        return buf.getvalue()
+
+    # --------------------------------------------------------------- dumps
+    def get_dump(self, fmap: str = "", with_stats: bool = False) -> List[str]:
+        from xgboost_tpu.dump import dump_trees
+        return dump_trees(self, fmap, with_stats)
+
+    def dump_model(self, fout: str, fmap: str = "", with_stats: bool = False):
+        dumps = self.get_dump(fmap, with_stats)
+        with open(fout, "w") as f:
+            for i, s in enumerate(dumps):
+                f.write(f"booster[{i}]:\n{s}")
+
+    def get_fscore(self, fmap: str = "") -> Dict[str, int]:
+        """Split-count feature importance (wrapper/xgboost.py:512-530)."""
+        from xgboost_tpu.dump import feature_importance
+        return feature_importance(self, fmap)
+
+
+def _pad_info(info: MetaInfo, n: int, pad: int) -> MetaInfo:
+    """Row-pad metadata with zero-weight rows so padded rows produce zero
+    gradients (group_ptr is left untouched: rows past gptr[-1] are
+    group-less and get no ranking pairs)."""
+    if pad == 0:
+        return info
+    out = MetaInfo()
+    if info.label is not None:
+        out.label = np.concatenate(
+            [info.label, np.zeros(pad, np.float32)])
+    out.weight = np.concatenate(
+        [info.get_weight(n), np.zeros(pad, np.float32)])
+    if info.base_margin is not None:
+        out.base_margin = np.concatenate(
+            [info.base_margin, np.zeros(pad, np.float32)])
+    if info.group_ptr is None:
+        # one explicit group over the real rows, so ranking objectives never
+        # pair padding rows
+        out.group_ptr = np.array([0, n], dtype=np.int64)
+    else:
+        out.group_ptr = info.group_ptr
+    return out
+
+
+def _jsonable(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, (np.integer,)):
+            v = int(v)
+        elif isinstance(v, (np.floating,)):
+            v = float(v)
+        elif isinstance(v, tuple):
+            v = list(v)
+        out[k] = v
+    return out
+
+
+_MAXIMIZE_METRICS = ("auc", "ams", "ndcg", "map", "pre")
+
+
+def train(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
+          evals: Sequence[Tuple[DMatrix, str]] = (), obj=None, feval=None,
+          maximize: Optional[bool] = None,
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[dict] = None, verbose_eval: bool = True,
+          xgb_model=None) -> Booster:
+    """Train a booster (reference wrapper/xgboost.py:533-632, including the
+    early-stopping protocol: best_score/best_iteration attributes, stop
+    after `early_stopping_rounds` non-improving rounds on the LAST metric
+    of the LAST eval set)."""
+    if xgb_model is not None:
+        bst = xgb_model if isinstance(xgb_model, Booster) else Booster(
+            params, model_file=xgb_model)
+        bst.set_param(params or {})
+    else:
+        bst = Booster(params, cache=[dtrain] + [d for d, _ in evals])
+
+    best_score = None
+    best_iter = 0
+    best_msg = ""
+    stopped_early = False
+
+    for i in range(num_boost_round):
+        bst.update(dtrain, i, fobj=obj)
+        if not evals:
+            continue
+        msg = bst.eval_set(evals, i, feval)
+        if verbose_eval:
+            print(msg)
+        scores = _parse_eval(msg)
+        if evals_result is not None:
+            for k, v in scores.items():
+                evals_result.setdefault(k, []).append(v)
+        if early_stopping_rounds is not None:
+            last_key = list(scores)[-1]
+            score = scores[last_key]
+            mx = maximize
+            if mx is None:
+                metric = last_key.split("-", 1)[1]
+                mx = any(metric.startswith(m) for m in _MAXIMIZE_METRICS)
+            improved = (best_score is None or
+                        (score > best_score if mx else score < best_score))
+            if improved:
+                best_score, best_iter, best_msg = score, i, msg
+            elif i - best_iter >= early_stopping_rounds:
+                if verbose_eval:
+                    print(f"Stopping. Best iteration:\n{best_msg}")
+                stopped_early = True
+                break
+    if early_stopping_rounds is not None and best_score is not None:
+        bst.best_score = best_score
+        bst.best_iteration = best_iter
+    return bst
+
+
+def _parse_eval(msg: str) -> Dict[str, float]:
+    out = {}
+    for part in msg.split("\t")[1:]:
+        k, _, v = part.rpartition(":")
+        out[k] = float(v)
+    return out
+
+
+class CVPack:
+    """One fold's (train, test, booster) bundle (wrapper/xgboost.py:635-650)."""
+
+    def __init__(self, dtrain: DMatrix, dtest: DMatrix, params: dict):
+        self.dtrain, self.dtest = dtrain, dtest
+        self.bst = Booster(params, cache=[dtrain, dtest])
+        self.watchlist = [(dtrain, "train"), (dtest, "test")]
+
+    def update(self, i, fobj):
+        self.bst.update(self.dtrain, i, fobj)
+
+    def eval(self, i, feval):
+        return self.bst.eval_set(self.watchlist, i, feval)
+
+
+def mknfold(dall: DMatrix, nfold: int, params: dict, seed: int,
+            evals=(), fpreproc=None) -> List[CVPack]:
+    """Random nfold partition (reference wrapper/xgboost.py:652-674)."""
+    rng = np.random.RandomState(seed)
+    idx = rng.permutation(dall.num_row)
+    folds = np.array_split(idx, nfold)
+    packs = []
+    for k in range(nfold):
+        test_idx = folds[k]
+        train_idx = np.concatenate([folds[j] for j in range(nfold) if j != k])
+        dtrain = dall.slice(np.sort(train_idx))
+        dtest = dall.slice(np.sort(test_idx))
+        p = dict(params or {})
+        if fpreproc is not None:
+            dtrain, dtest, p = fpreproc(dtrain, dtest, p)
+        packs.append(CVPack(dtrain, dtest, p))
+    return packs
+
+
+def aggcv(rlist: List[str], show_stdv: bool = True) -> str:
+    """Aggregate per-fold eval lines into cv mean+std (wrapper
+    xgboost.py:676-695)."""
+    cvmap: Dict[str, List[float]] = {}
+    ret = rlist[0].split("\t")[0]
+    for line in rlist:
+        for part in line.split("\t")[1:]:
+            k, _, v = part.rpartition(":")
+            cvmap.setdefault(k, []).append(float(v))
+    for k, vals in cvmap.items():
+        v = np.asarray(vals)
+        if show_stdv:
+            ret += f"\tcv-{k}:{v.mean():.6f}+{v.std():.6f}"
+        else:
+            ret += f"\tcv-{k}:{v.mean():.6f}"
+    return ret
+
+
+def cv(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
+       nfold: int = 3, metrics=(), obj=None, feval=None, fpreproc=None,
+       show_stdv: bool = True, seed: int = 0,
+       verbose_eval: bool = True) -> List[str]:
+    """k-fold cross validation (reference wrapper/xgboost.py:697-740)."""
+    params = dict(params or {})
+    if metrics:
+        params["eval_metric"] = list(metrics)
+    packs = mknfold(dtrain, nfold, params, seed, fpreproc=fpreproc)
+    results = []
+    for i in range(num_boost_round):
+        for p in packs:
+            p.update(i, obj)
+        line = aggcv([p.eval(i, feval) for p in packs], show_stdv)
+        if verbose_eval:
+            print(line)
+        results.append(line)
+    return results

@@ -1,0 +1,212 @@
+"""Evaluation metrics.
+
+Re-implements the reference metric set and registry
+(``src/learner/evaluation-inl.hpp``, registry ``evaluation.h:42-59``):
+elementwise rmse/logloss/error (:24-107), multiclass merror/mlogloss
+(:113-199), AMS (:243-300), precision-ratio family (:302-352), AUC
+(:355-419), and the ranklist metrics pre@n/ndcg@n/map@n (:422-565) with
+the trailing ``-`` convention (lists without positives score 0 instead
+of 1).
+
+Metrics run host-side in numpy (they are cheap relative to training);
+predictions arrive already eval-transformed by the objective.  In
+distributed mode predictions are global (single-controller JAX), so the
+(sum, wsum) rabit allreduce of the reference (``evaluation-inl.hpp:45``)
+is unnecessary; AUC is computed exactly rather than as the reference's
+approximate mean-of-workers (``:405-414`` — documented difference).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+_EPS = 1e-16
+
+
+def _wmean(values: np.ndarray, weights: np.ndarray) -> float:
+    return float(np.sum(values * weights) / np.sum(weights))
+
+
+# ------------------------------------------------------------ elementwise
+
+def rmse(preds, labels, weights, group_ptr=None):
+    return float(np.sqrt(_wmean((preds - labels) ** 2, weights)))
+
+
+def logloss(preds, labels, weights, group_ptr=None):
+    p = np.clip(preds, _EPS, 1.0 - _EPS)
+    ll = -(labels * np.log(p) + (1.0 - labels) * np.log(1.0 - p))
+    return _wmean(ll, weights)
+
+
+def error(preds, labels, weights, group_ptr=None):
+    wrong = np.where(preds > 0.5, labels != 1.0, labels != 0.0)
+    return _wmean(wrong.astype(np.float64), weights)
+
+
+def merror(preds, labels, weights, group_ptr=None):
+    yhat = np.argmax(preds, axis=1)
+    return _wmean((yhat != labels.astype(np.int64)).astype(np.float64), weights)
+
+
+def mlogloss(preds, labels, weights, group_ptr=None):
+    p = np.clip(preds[np.arange(len(labels)), labels.astype(np.int64)],
+                _EPS, None)
+    return _wmean(-np.log(p), weights)
+
+
+# ------------------------------------------------------------------- AUC
+
+def auc(preds, labels, weights, group_ptr=None):
+    """Weighted AUC; averaged over groups when group_ptr is given
+    (reference EvalAuc, evaluation-inl.hpp:355-419).  Tied predictions are
+    handled as half-credit buckets, matching the reference's bucket scan
+    (:377-397), vectorized over tie-groups."""
+    preds = preds.ravel()
+    if group_ptr is None:
+        group_ptr = np.array([0, len(preds)])
+    total, ngroup = 0.0, 0
+    for g in range(len(group_ptr) - 1):
+        s, e = group_ptr[g], group_ptr[g + 1]
+        v = _auc_group(preds[s:e], labels[s:e], weights[s:e])
+        if v is None:
+            continue
+        total += v
+        ngroup += 1
+    if ngroup == 0:
+        raise ValueError("AUC: the dataset only contains pos or neg samples")
+    return float(total / ngroup)
+
+
+def _auc_group(p, y, w):
+    order = np.argsort(p, kind="stable")
+    p, y, w = p[order], y[order], w[order]
+    wpos = w * (y > 0)
+    wneg = w * (y <= 0)
+    tot_pos, tot_neg = wpos.sum(), wneg.sum()
+    if tot_pos <= 0 or tot_neg <= 0:
+        return None
+    boundary = np.concatenate([[True], p[1:] != p[:-1]])
+    gid = np.cumsum(boundary) - 1
+    gpos = np.zeros(gid[-1] + 1)
+    gneg = np.zeros(gid[-1] + 1)
+    np.add.at(gpos, gid, wpos)
+    np.add.at(gneg, gid, wneg)
+    cum_neg_before = np.cumsum(gneg) - gneg
+    sum_auc = np.sum(gpos * (cum_neg_before + 0.5 * gneg))
+    return sum_auc / (tot_pos * tot_neg)
+
+
+# ------------------------------------------------------------------- AMS
+
+def ams(preds, labels, weights, group_ptr=None, ratio: float = 0.15):
+    """Approximate median significance at threshold `ratio`
+    (reference EvalAMS, evaluation-inl.hpp:243-300; Higgs challenge)."""
+    preds = preds.ravel()
+    order = np.argsort(-preds, kind="stable")
+    ntop = int(ratio * len(preds))
+    if ntop == 0:
+        ntop = len(preds)
+    sel = order[:ntop]
+    br = 10.0
+    s = float(np.sum(weights[sel] * (labels[sel] == 1.0)))
+    b = float(np.sum(weights[sel] * (labels[sel] != 1.0)))
+    val = 2.0 * ((s + b + br) * np.log(1.0 + s / (b + br)) - s)
+    return float(np.sqrt(max(val, 0.0)))
+
+
+# ------------------------------------------------------- ranklist metrics
+
+def _dcg_at(rels: np.ndarray, n: int) -> float:
+    rels = rels[:n]
+    return float(np.sum((2.0 ** rels - 1.0) / np.log2(np.arange(len(rels)) + 2.0)))
+
+
+def ndcg(preds, labels, weights, group_ptr=None, n: int = 0, minus=False):
+    return _rank_metric(preds, labels, group_ptr, n, minus, _ndcg_group)
+
+
+def _ndcg_group(p, y, n):
+    n = n if n > 0 else len(p)
+    order = np.argsort(-p, kind="stable")
+    dcg = _dcg_at(y[order], n)
+    idcg = _dcg_at(np.sort(y)[::-1], n)
+    if idcg == 0.0:
+        return None  # no relevant docs
+    return dcg / idcg
+
+
+def map_metric(preds, labels, weights, group_ptr=None, n: int = 0, minus=False):
+    return _rank_metric(preds, labels, group_ptr, n, minus, _map_group)
+
+
+def _map_group(p, y, n):
+    order = np.argsort(-p, kind="stable")
+    rel = (y[order] > 0).astype(np.float64)
+    if rel.sum() == 0:
+        return None
+    n = n if n > 0 else len(p)
+    hits = np.cumsum(rel)
+    prec = rel * hits / np.arange(1, len(rel) + 1)
+    return float(np.sum(prec[:n]) / min(rel.sum(), n))
+
+
+def precision_at(preds, labels, weights, group_ptr=None, n: int = 0, minus=False):
+    return _rank_metric(preds, labels, group_ptr, n, minus, _pre_group)
+
+
+def _pre_group(p, y, n):
+    n = n if n > 0 else len(p)
+    order = np.argsort(-p, kind="stable")
+    return float(np.sum(y[order][:n] > 0) / n)
+
+
+def _rank_metric(preds, labels, group_ptr, n, minus, fn):
+    preds = preds.ravel()
+    if group_ptr is None:
+        group_ptr = np.array([0, len(preds)])
+    total, ngroup = 0.0, 0
+    for g in range(len(group_ptr) - 1):
+        s, e = group_ptr[g], group_ptr[g + 1]
+        v = fn(preds[s:e], labels[s:e], n)
+        if v is None:
+            v = 0.0 if minus else 1.0
+        total += v
+        ngroup += 1
+    return float(total / max(ngroup, 1))
+
+
+# --------------------------------------------------------------- registry
+
+def create_metric(name: str) -> Callable:
+    """Metric factory (reference CreateEvaluator, evaluation.h:42-59).
+
+    Supports suffixed names: ``ndcg@10``, ``map@5-``, ``pre@3``, ``ams@0.15``.
+    """
+    base, at, suffix = name.partition("@")
+    minus = False
+    if suffix.endswith("-"):
+        minus, suffix = True, suffix[:-1]
+    simple: Dict[str, Callable] = {
+        "rmse": rmse, "logloss": logloss, "error": error,
+        "merror": merror, "mlogloss": mlogloss, "auc": auc,
+    }
+    if not at and base in simple:
+        return _named(simple[base], name)
+    if base == "ams":
+        ratio = float(suffix) if suffix else 0.15
+        return _named(lambda p, l, w, g=None: ams(p, l, w, g, ratio), name)
+    topn = int(float(suffix)) if suffix else 0
+    rankers = {"ndcg": ndcg, "map": map_metric, "pre": precision_at}
+    if base in rankers:
+        fn = rankers[base]
+        return _named(
+            lambda p, l, w, g=None: fn(p, l, w, g, topn, minus), name)
+    raise ValueError(f"unknown evaluation metric type: {name}")
+
+
+def _named(fn: Callable, name: str) -> Callable:
+    fn.metric_name = name
+    return fn

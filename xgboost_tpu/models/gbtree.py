@@ -1,0 +1,163 @@
+"""GBTree: gradient-boosted tree ensemble booster.
+
+The reference's ``GBTree`` (``src/gbm/gbtree-inl.hpp``): per-class tree
+groups (:102-121), ``num_parallel_tree`` boosted-random-forest mode
+(:393-396), prediction buffers keyed by leaf positions (:258-303), and
+model commit per boosting round.  Here trees are fixed-shape tensor
+stacks; the prediction "buffer" is an incrementally maintained margin
+per cached DMatrix, updated from grow-time leaf positions — the same
+fast path as the reference's ``GetLeafPosition`` shortcut
+(``updater_distcol-inl.hpp:40-42``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xgboost_tpu.binning import CutMatrix
+from xgboost_tpu.config import TrainParam
+from xgboost_tpu.models.tree import (GrowConfig, TreeArrays, grow_tree,
+                                     predict_leaf_binned,
+                                     predict_margin_binned, tree_capacity)
+from xgboost_tpu.ops.split import SplitConfig
+
+
+def make_grow_config(p: TrainParam, n_bin: int) -> GrowConfig:
+    split = SplitConfig(
+        reg_lambda=p.reg_lambda, reg_alpha=p.reg_alpha,
+        max_delta_step=p.max_delta_step, min_child_weight=p.min_child_weight,
+        gamma=p.gamma, eta=p.eta, default_direction=p.default_direction)
+    return GrowConfig(split=split, max_depth=p.max_depth, n_bin=n_bin,
+                      subsample=p.subsample,
+                      colsample_bytree=p.colsample_bytree,
+                      colsample_bylevel=p.colsample_bylevel)
+
+
+class GBTree:
+    """Tree ensemble state + boosting step (reference IGradBooster: DoBoost /
+    Predict / PredictLeaf / DumpModel, src/gbm/gbm.h:19-125)."""
+
+    def __init__(self, param: TrainParam, cuts: CutMatrix):
+        self.param = param
+        self.cuts = cuts
+        self.cfg = make_grow_config(param, cuts.max_bin)
+        self.trees: List[TreeArrays] = []      # device pytrees, one per tree
+        self.tree_group: List[int] = []
+        self._stack_cache: Optional[Tuple[int, TreeArrays, jax.Array]] = None
+        self.cut_values_dev = jnp.asarray(cuts.cut_values)
+        self.n_cuts_dev = jnp.asarray(cuts.n_cuts)
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    @property
+    def num_boosted_rounds(self) -> int:
+        k = max(1, self.param.num_output_group) * max(
+            1, self.param.num_parallel_tree)
+        return len(self.trees) // k
+
+    # ---------------------------------------------------------------- boost
+    def do_boost(self, binned: jax.Array, gh: jax.Array, key: jax.Array,
+                 row_valid: Optional[jax.Array] = None,
+                 mesh=None) -> Tuple[List[TreeArrays], jax.Array]:
+        """One boosting round: grows num_output_group × num_parallel_tree
+        trees (reference BoostNewTrees, gbtree-inl.hpp:238-273).
+
+        gh: (N, K, 2).  Returns (new_trees, leaf_contrib (N, K) margin delta)
+        computed from grow-time leaf positions — the prediction-buffer fast
+        path (gbtree-inl.hpp:258-303).  With a mesh, rows are sharded over
+        the 'data' axis and histograms psum-reduced (SURVEY.md §5.8).
+        """
+        K = max(1, self.param.num_output_group)
+        npar = max(1, self.param.num_parallel_tree)
+        new_trees: List[TreeArrays] = []
+        deltas = []
+        for k in range(K):
+            delta_k = None
+            for t in range(npar):
+                tkey = jax.random.fold_in(key, k * npar + t)
+                if mesh is not None:
+                    from xgboost_tpu.parallel.dp import grow_tree_dp
+                    rv = row_valid if row_valid is not None else \
+                        jnp.ones(binned.shape[0], jnp.bool_)
+                    tree, row_leaf, d = grow_tree_dp(
+                        mesh, tkey, binned, gh[:, k, :], self.cut_values_dev,
+                        self.n_cuts_dev, self.cfg, rv)
+                else:
+                    tree, row_leaf = grow_tree(
+                        tkey, binned, gh[:, k, :], self.cut_values_dev,
+                        self.n_cuts_dev, self.cfg, row_valid)
+                    d = tree.leaf_value[row_leaf]
+                new_trees.append(tree)
+                self.trees.append(tree)
+                self.tree_group.append(k)
+                delta_k = d if delta_k is None else delta_k + d
+            deltas.append(delta_k)
+        self._stack_cache = None
+        return new_trees, jnp.stack(deltas, axis=1)
+
+    # -------------------------------------------------------------- predict
+    def _stack(self, ntree_limit: int = 0):
+        """Stack trees (optionally first ntree_limit) into (T, ...) arrays."""
+        T = self.num_trees if ntree_limit == 0 else min(
+            ntree_limit, self.num_trees)
+        if self._stack_cache is not None and self._stack_cache[0] == T:
+            return self._stack_cache[1], self._stack_cache[2]
+        assert T > 0, "model is empty"
+        stack = jax.tree.map(lambda *xs: jnp.stack(xs), *self.trees[:T])
+        group = jnp.asarray(self.tree_group[:T], dtype=jnp.int32)
+        self._stack_cache = (T, stack, group)
+        return stack, group
+
+    def predict_margin(self, binned: jax.Array, base: jax.Array,
+                       ntree_limit: int = 0) -> jax.Array:
+        stack, group = self._stack(ntree_limit)
+        return predict_margin_binned(
+            stack, group, binned, base, self.cfg.max_depth,
+            max(1, self.param.num_output_group))
+
+    def predict_incremental(self, binned: jax.Array, margin: jax.Array,
+                            new_trees: List[TreeArrays],
+                            first_group: int = 0) -> jax.Array:
+        """Add the contribution of freshly grown trees to a cached margin
+        (fixed shapes per round -> single compilation)."""
+        K = max(1, self.param.num_output_group)
+        npar = max(1, self.param.num_parallel_tree)
+        stack = jax.tree.map(lambda *xs: jnp.stack(xs), *new_trees)
+        group = jnp.asarray(
+            [first_group + i // npar for i in range(len(new_trees))],
+            dtype=jnp.int32)
+        return predict_margin_binned(
+            stack, group, binned, jnp.zeros((), jnp.float32),
+            self.cfg.max_depth, K) + margin
+
+    def predict_leaf(self, binned: jax.Array, ntree_limit: int = 0) -> jax.Array:
+        stack, _ = self._stack(ntree_limit)
+        return predict_leaf_binned(stack, binned, self.cfg.max_depth)
+
+    # ------------------------------------------------------------ serialize
+    def get_state(self) -> dict:
+        stack, group = self._stack(0)
+        state = {f"tree_{f}": np.asarray(getattr(stack, f))
+                 for f in TreeArrays._fields}
+        state["tree_group_arr"] = np.asarray(group)
+        state["cut_values"] = self.cuts.cut_values
+        state["cut_n"] = self.cuts.n_cuts
+        return state
+
+    @classmethod
+    def from_state(cls, param: TrainParam, state: dict) -> "GBTree":
+        cuts = CutMatrix(state["cut_values"], state["cut_n"])
+        gbt = cls(param, cuts)
+        stack = TreeArrays(**{f: jnp.asarray(state[f"tree_{f}"])
+                              for f in TreeArrays._fields})
+        T = stack.feature.shape[0]
+        for i in range(T):
+            gbt.trees.append(jax.tree.map(lambda x: x[i], stack))
+        gbt.tree_group = [int(g) for g in state["tree_group_arr"]]
+        return gbt

@@ -1,0 +1,248 @@
+"""Struct-of-arrays regression trees: growth and traversal.
+
+Replaces the reference's pointer-y ``TreeModel``/``RegTree``
+(``src/tree/model.h:26-567``) with fixed-shape tensors: a tree of
+``max_depth`` D occupies a perfect binary layout of ``2**(D+1)-1`` nodes
+(node g has children 2g+1 / 2g+2), each field its own array.  Growth is
+level-by-level — the strategy of the reference's histogram updaters
+(``updater_histmaker-inl.hpp:124-147``) — with every level one
+histogram + argmax + partition step on device.
+
+The ``hist_reduce`` hook is the collective seam: single-chip it is the
+identity; the data-parallel path passes ``lax.psum`` over the mesh axis,
+which is exactly where the reference called ``rabit`` Allreduce
+(``histmaker-inl.hpp:343-346``; SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from xgboost_tpu.ops.histogram import build_level_histogram, node_stats
+from xgboost_tpu.ops.split import SplitConfig, calc_weight, find_best_splits
+
+
+class TreeArrays(NamedTuple):
+    """One regression tree (or a (T, ...) stack of them)."""
+    feature: jax.Array       # (n_nodes,) int32, -1 if leaf/unused
+    cut_index: jax.Array     # (n_nodes,) int32
+    threshold: jax.Array     # (n_nodes,) f32 — raw-value cut (v < thr -> left)
+    default_left: jax.Array  # (n_nodes,) bool
+    is_leaf: jax.Array       # (n_nodes,) bool
+    leaf_value: jax.Array    # (n_nodes,) f32 (eta-scaled)
+    gain: jax.Array          # (n_nodes,) f32 loss_chg of the split (stat)
+    sum_hess: jax.Array      # (n_nodes,) f32 node hessian sum (stat)
+
+    @property
+    def n_nodes(self) -> int:
+        return self.feature.shape[-1]
+
+
+class GrowConfig(NamedTuple):
+    """Static configuration of the growth kernel."""
+    split: SplitConfig
+    max_depth: int
+    n_bin: int               # histogram bins B (incl. missing bin 0)
+    subsample: float = 1.0
+    colsample_bytree: float = 1.0
+    colsample_bylevel: float = 1.0
+
+
+def tree_capacity(max_depth: int) -> int:
+    return 2 ** (max_depth + 1) - 1
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "hist_reduce"))
+def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
+              cut_values: jax.Array, n_cuts: jax.Array, cfg: GrowConfig,
+              row_valid: Optional[jax.Array] = None,
+              hist_reduce: Callable[[jax.Array], jax.Array] = None):
+    """Grow one tree level-by-level.
+
+    Args:
+      key: PRNG key for row/column subsampling.
+      binned: (N, F) bin ids (0 = missing).
+      gh: (N, 2) gradient pairs.
+      cut_values: (F, C) padded raw cut values, n_cuts: (F,).
+      row_valid: optional (N,) bool — rows that belong to this shard/set
+        (padding rows excluded from both stats and leaf assignment).
+      hist_reduce: collective reduction applied to every histogram and
+        node-stat tensor (identity when None; psum over 'data' in DP mode).
+
+    Returns (tree: TreeArrays, row_leaf: (N,) int32 global leaf node per row).
+    """
+    N, F = binned.shape
+    D = cfg.max_depth
+    n_total = tree_capacity(D)
+    red = hist_reduce if hist_reduce is not None else (lambda x: x)
+
+    key_rows, key_ftree, key_flevel = jax.random.split(key, 3)
+
+    # row subsampling (reference TrainParam::subsample applied at gradient
+    # level, updater_colmaker-inl.hpp:115-146): dropped rows contribute no
+    # statistics but still flow to a leaf for the prediction cache.
+    gh_used = gh
+    if cfg.subsample < 1.0:
+        keep = jax.random.uniform(key_rows, (N,)) < cfg.subsample
+        gh_used = gh * keep[:, None].astype(gh.dtype)
+    if row_valid is not None:
+        gh_used = gh_used * row_valid[:, None].astype(gh.dtype)
+
+    # column sampling bytree (colmaker-inl.hpp:148-160): boolean mask, no
+    # replacement semantics approximated by per-feature bernoulli with a
+    # guaranteed non-empty fallback.
+    feat_mask_tree = _sample_features(key_ftree, F, cfg.colsample_bytree)
+
+    tree = TreeArrays(
+        feature=jnp.full(n_total, -1, jnp.int32),
+        cut_index=jnp.zeros(n_total, jnp.int32),
+        threshold=jnp.zeros(n_total, jnp.float32),
+        default_left=jnp.zeros(n_total, jnp.bool_),
+        is_leaf=jnp.zeros(n_total, jnp.bool_),
+        leaf_value=jnp.zeros(n_total, jnp.float32),
+        gain=jnp.zeros(n_total, jnp.float32),
+        sum_hess=jnp.zeros(n_total, jnp.float32),
+    )
+
+    pos = jnp.zeros(N, jnp.int32)  # level-local position; -1 = parked in a leaf
+    if row_valid is not None:
+        pos = jnp.where(row_valid, pos, -1)
+    row_leaf = jnp.zeros(N, jnp.int32)
+
+    for depth in range(D + 1):
+        n_node = 1 << depth
+        base = n_node - 1  # global index of first node at this level
+        nst = red(node_stats(gh_used, pos, n_node))  # (n_node, 2)
+
+        if depth == D:
+            # terminal level: everything still active becomes a leaf
+            make_leaf = jnp.ones(n_node, jnp.bool_)
+            best = None
+        else:
+            hist = red(build_level_histogram(binned, gh_used, pos,
+                                             n_node, cfg.n_bin))
+            fmask = feat_mask_tree
+            if cfg.colsample_bylevel < 1.0:
+                fmask = fmask & _sample_features(
+                    jax.random.fold_in(key_flevel, depth), F,
+                    cfg.colsample_bylevel)
+            best = find_best_splits(hist, nst, n_cuts, cfg.split, fmask)
+            # cannot_split (param.h:174): too little hessian mass to split
+            can_try = nst[:, 1] >= 2.0 * cfg.split.min_child_weight
+            do_split = best.valid & can_try
+            make_leaf = ~do_split
+
+        # node occupancy: a level node is "live" iff some ancestor path made
+        # it; detect via sum_hess>0 OR it is the root.  Empty nodes get
+        # is_leaf=False and are unreachable, which is fine.
+        live = (nst[:, 1] > 0.0) | (jnp.arange(n_node) == 0) if depth == 0 \
+            else (nst[:, 1] > 0.0)
+
+        leaf_w = calc_weight(nst[:, 0], nst[:, 1], cfg.split) * cfg.split.eta
+        idx = base + jnp.arange(n_node)
+        tree = tree._replace(
+            sum_hess=tree.sum_hess.at[idx].set(nst[:, 1]),
+            is_leaf=tree.is_leaf.at[idx].set(make_leaf & live),
+            leaf_value=tree.leaf_value.at[idx].set(
+                jnp.where(make_leaf, leaf_w, 0.0)),
+        )
+        if best is not None:
+            thr = cut_values[best.feature, best.cut_index]
+            keep_split = ~make_leaf
+            tree = tree._replace(
+                feature=tree.feature.at[idx].set(
+                    jnp.where(keep_split, best.feature, -1)),
+                cut_index=tree.cut_index.at[idx].set(best.cut_index),
+                threshold=tree.threshold.at[idx].set(thr),
+                default_left=tree.default_left.at[idx].set(best.default_left),
+                gain=tree.gain.at[idx].set(
+                    jnp.where(keep_split, best.gain, 0.0)),
+            )
+
+        # park rows whose node became a leaf; route the rest to children
+        active = pos >= 0
+        node_of_row = jnp.clip(pos, 0, n_node - 1)
+        row_is_leaf = active & make_leaf[node_of_row]
+        row_leaf = jnp.where(row_is_leaf, base + pos, row_leaf)
+        if best is not None:
+            f_row = best.feature[node_of_row]              # (N,)
+            j_row = best.cut_index[node_of_row]
+            dl_row = best.default_left[node_of_row]
+            b = jnp.take_along_axis(binned.astype(jnp.int32),
+                                    f_row[:, None], axis=1)[:, 0]
+            go_left = jnp.where(b == 0, dl_row, b <= j_row + 1)
+            new_pos = 2 * pos + (~go_left).astype(jnp.int32)
+            pos = jnp.where(active & ~row_is_leaf, new_pos, -1)
+
+    return tree, row_leaf
+
+
+def _sample_features(key: jax.Array, F: int, rate: float) -> jax.Array:
+    if rate >= 1.0:
+        return jnp.ones(F, jnp.bool_)
+    mask = jax.random.uniform(key, (F,)) < rate
+    # never allow an empty feature set (reference resamples until non-empty)
+    fallback = jnp.zeros(F, jnp.bool_).at[
+        jax.random.randint(key, (), 0, F)].set(True)
+    return jnp.where(mask.any(), mask, fallback)
+
+
+# ---------------------------------------------------------------- traversal
+
+def _traverse_one(tree: TreeArrays, binned: jax.Array, max_depth: int):
+    """Leaf index per row for one tree on binned data.
+
+    Matches reference RegTree::GetLeafIndex / GetNext (model.h:534-566)
+    including missing-value default direction.
+    """
+    N = binned.shape[0]
+    node = jnp.zeros(N, jnp.int32)
+    for _ in range(max_depth):
+        f = tree.feature[node]
+        leaf = tree.is_leaf[node] | (f < 0)
+        b = jnp.take_along_axis(binned.astype(jnp.int32),
+                                jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        go_left = jnp.where(b == 0, tree.default_left[node],
+                            b <= tree.cut_index[node] + 1)
+        nxt = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
+        node = jnp.where(leaf, node, nxt)
+    return node
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_group"))
+def predict_margin_binned(stack: TreeArrays, tree_group: jax.Array,
+                          binned: jax.Array, base: jax.Array,
+                          max_depth: int, n_group: int) -> jax.Array:
+    """Sum of leaf values over a (T, n_nodes) stacked ensemble.
+
+    Scanned over trees so one compilation serves any ensemble size with
+    the same (N, n_nodes) shapes.  Returns (N, n_group) margins.
+    """
+    N = binned.shape[0]
+
+    def body(margin, tg):
+        tree, group = tg
+        leaf = _traverse_one(tree, binned, max_depth)
+        contrib = tree.leaf_value[leaf]
+        margin = margin + contrib[:, None] * jax.nn.one_hot(
+            group, n_group, dtype=margin.dtype)
+        return margin, None
+
+    margin0 = jnp.broadcast_to(base, (N, n_group)).astype(jnp.float32)
+    margin, _ = jax.lax.scan(body, margin0, (stack, tree_group))
+    return margin
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_leaf_binned(stack: TreeArrays, binned: jax.Array,
+                        max_depth: int) -> jax.Array:
+    """(N, T) leaf node index per tree (reference PredictLeaf,
+    gbtree-inl.hpp:355-385)."""
+    def body(_, tree):
+        return None, _traverse_one(tree, binned, max_depth)
+    _, leaves = jax.lax.scan(body, None, stack)
+    return leaves.T

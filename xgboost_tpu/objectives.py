@@ -1,0 +1,178 @@
+"""Objective functions: gradient/hessian computation.
+
+Re-implements the reference objective registry
+(``src/learner/objective.h:69-82``, 9 names) with elementwise gradients
+as jitted device functions.  Math follows
+``src/learner/objective-inl.hpp``:
+  - LossType transforms and grads (:22-114)
+  - RegLossObj incl. scale_pos_weight (:117-174)
+  - SoftmaxMultiClassObj (:177-271) — h = 2 p (1-p)
+  - LambdaRank family (:274-570) — pair sampling is host-side per round,
+    pair gradients are device-side (see :mod:`xgboost_tpu.rank_obj`).
+
+Margins are (N, K) with K = num output groups (1 unless multiclass).
+Gradients returned as (N, K, 2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-16
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+class Objective:
+    """Base objective (reference IObjFunction, src/learner/objective.h:13-59)."""
+
+    name: str = ""
+    default_metric: str = "rmse"
+    output_group_count: int = 1
+
+    def set_param(self, name, value):
+        pass
+
+    def get_gradient(self, margin, info, iteration, n_rows):
+        """margin: (N, K) jnp; info: MetaInfo; returns (N, K, 2) jnp."""
+        raise NotImplementedError
+
+    def pred_transform(self, margin, output_margin=False):
+        return margin
+
+    def eval_transform(self, margin):
+        """Transform used before metric evaluation (softprob for multiclass)."""
+        return self.pred_transform(margin)
+
+    def prob_to_margin(self, base_score: float) -> float:
+        return base_score
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "spw"))
+def _regloss_grad(margin, label, weight, loss: str, spw: float):
+    x = margin[:, 0]
+    if loss == "linear":
+        p = x
+        g, h = p - label, jnp.ones_like(p)
+    else:  # all logistic variants share gradient math on transformed pred
+        p = _sigmoid(x)
+        g = p - label
+        h = jnp.maximum(p * (1.0 - p), _EPS)
+    w = jnp.where(label == 1.0, weight * spw, weight)
+    return jnp.stack([g * w, h * w], axis=-1)[:, None, :]
+
+
+class RegLossObj(Objective):
+    """reg:linear, reg:logistic, binary:logistic, binary:logitraw
+    (reference RegLossObj, objective-inl.hpp:117-174)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.scale_pos_weight = 1.0
+        self.loss = "linear" if name == "reg:linear" else "logistic"
+        self.transform_pred = name in ("reg:logistic", "binary:logistic")
+        self.default_metric = {"reg:linear": "rmse", "reg:logistic": "rmse",
+                               "binary:logistic": "error",
+                               "binary:logitraw": "auc"}[name]
+
+    def set_param(self, name, value):
+        if name == "scale_pos_weight":
+            self.scale_pos_weight = float(value)
+
+    def get_gradient(self, margin, info, iteration, n_rows):
+        label = jnp.asarray(info.label)
+        if self.loss != "linear":
+            lab = np.asarray(info.label)
+            if ((lab < 0) | (lab > 1)).any():
+                raise ValueError(
+                    "label must be in [0,1] for logistic regression")
+        weight = jnp.asarray(info.get_weight(n_rows))
+        return _regloss_grad(margin, label, weight, self.loss,
+                             float(self.scale_pos_weight))
+
+    def pred_transform(self, margin, output_margin=False):
+        if output_margin or not self.transform_pred:
+            return margin
+        return _sigmoid(margin)
+
+    def eval_transform(self, margin):
+        # metrics see transformed predictions except for logitraw's margin
+        # (reference EvalTransform == PredTransform for RegLossObj)
+        return self.pred_transform(margin)
+
+    def prob_to_margin(self, base_score: float) -> float:
+        if self.name != "reg:linear":
+            assert 0.0 < base_score < 1.0, \
+                "base_score must be in (0,1) for logistic loss"
+            return -np.log(1.0 / base_score - 1.0)
+        return base_score
+
+
+@jax.jit
+def _softmax_grad(margin, label, weight):
+    p = jax.nn.softmax(margin, axis=1)          # (N, K)
+    K = margin.shape[1]
+    y = jax.nn.one_hot(label.astype(jnp.int32), K, dtype=p.dtype)
+    g = (p - y) * weight[:, None]
+    h = 2.0 * p * (1.0 - p) * weight[:, None]
+    return jnp.stack([g, h], axis=-1)
+
+
+class SoftmaxMultiClassObj(Objective):
+    """multi:softmax / multi:softprob (reference objective-inl.hpp:177-271)."""
+
+    def __init__(self, output_prob: bool):
+        self.name = "multi:softprob" if output_prob else "multi:softmax"
+        self.output_prob = output_prob
+        self.nclass = 0
+        self.default_metric = "merror"
+
+    @property
+    def output_group_count(self):
+        return max(1, self.nclass)
+
+    def set_param(self, name, value):
+        if name == "num_class":
+            self.nclass = int(value)
+
+    def get_gradient(self, margin, info, iteration, n_rows):
+        assert self.nclass > 0, "must set num_class to use softmax"
+        lab = np.asarray(info.label)
+        if ((lab < 0) | (lab >= self.nclass)).any():
+            raise ValueError(
+                f"SoftmaxMultiClassObj: label must be in [0, {self.nclass})")
+        label = jnp.asarray(info.label)
+        weight = jnp.asarray(info.get_weight(n_rows))
+        return _softmax_grad(margin, label, weight)
+
+    def pred_transform(self, margin, output_margin=False):
+        if output_margin:
+            return margin
+        if self.output_prob:
+            return jax.nn.softmax(margin, axis=1)
+        return jnp.argmax(margin, axis=1).astype(jnp.float32)[:, None]
+
+    def eval_transform(self, margin):
+        return jax.nn.softmax(margin, axis=1)
+
+
+def create_objective(name: str) -> Objective:
+    """Objective factory (reference CreateObjFunction, objective.h:69-82)."""
+    if name in ("reg:linear", "reg:logistic", "binary:logistic",
+                "binary:logitraw"):
+        return RegLossObj(name)
+    if name == "multi:softmax":
+        return SoftmaxMultiClassObj(False)
+    if name == "multi:softprob":
+        return SoftmaxMultiClassObj(True)
+    if name in ("rank:pairwise", "rank:ndcg", "rank:map"):
+        from xgboost_tpu.rank_obj import LambdaRankObj
+        return LambdaRankObj(name)
+    raise ValueError(f"unknown objective function type: {name}")

@@ -1,0 +1,48 @@
+"""Gradient/hessian histogram accumulation.
+
+The TPU-native replacement for the reference's per-thread histogram
+loops (``src/tree/updater_histmaker-inl.hpp:296-348``): one scatter-add
+over ``(node, feature, bin)`` cells per tree level, executed on device.
+Every (active) row contributes exactly one bin per feature — including
+the reserved missing bin 0 — so the per-node totals equal the bin-sums
+of any single feature.
+
+A Pallas kernel variant lives in :mod:`xgboost_tpu.ops.pallas_hist`
+(selected automatically on TPU); this XLA scatter is the portable path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def build_level_histogram(binned: jax.Array, gh: jax.Array, pos: jax.Array,
+                          n_node: int, n_bin: int) -> jax.Array:
+    """Accumulate per-(node, feature, bin) grad/hess sums for one level.
+
+    Args:
+      binned: (N, F) integer bin ids (0 = missing).
+      gh:     (N, 2) grad/hess per row (zeros for subsampled-out rows).
+      pos:    (N,) level-local node position in [0, n_node), -1 = inactive.
+      n_node: static number of nodes at this level (2**depth).
+      n_bin:  static number of bins B.
+
+    Returns: (n_node, F, B, 2) float32.
+    """
+    N, F = binned.shape
+    f_ids = jnp.arange(F, dtype=jnp.int32)[None, :]
+    flat = (pos[:, None] * F + f_ids) * n_bin + binned.astype(jnp.int32)
+    # inactive rows (pos < 0) -> out-of-bounds index, dropped by the scatter
+    flat = jnp.where(pos[:, None] < 0, n_node * F * n_bin, flat)
+    hist = jnp.zeros((n_node * F * n_bin, 2), dtype=jnp.float32)
+    hist = hist.at[flat].add(gh[:, None, :], mode="drop")
+    return hist.reshape(n_node, F, n_bin, 2)
+
+
+def node_stats(gh: jax.Array, pos: jax.Array, n_node: int) -> jax.Array:
+    """Per-node (G, H) sums via segment-sum (reference GetNodeStats,
+    ``updater_basemaker-inl.hpp:266-306``).  Returns (n_node, 2)."""
+    idx = jnp.where(pos < 0, n_node, pos)
+    out = jnp.zeros((n_node, 2), dtype=jnp.float32)
+    return out.at[idx].add(gh, mode="drop")

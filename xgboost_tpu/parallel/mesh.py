@@ -1,0 +1,41 @@
+"""Device mesh context for distributed training.
+
+The reference's cluster layer (rabit tracker rendezvous + rank/world,
+``subtree/rabit/tracker/rabit_tracker.py:125-309``) collapses to a
+``jax.sharding.Mesh``: the JAX runtime owns rendezvous and the mesh
+axis name is the communicator.  The flagship mode is row-split data
+parallelism over axis ``"data"`` (SURVEY.md §2.4 item 2 → psum over ICI).
+
+Multi-host: build the mesh over ``jax.devices()`` after
+``jax.distributed.initialize()`` — same code path, collectives ride
+ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+_default_mesh: Optional[Mesh] = None
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    """Install a process-wide default mesh for dsplit=row training."""
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _default_mesh
+
+
+def data_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first n (default all) devices, axis 'data'."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return jax.make_mesh((len(devs),), (DATA_AXIS,), devices=devs)
