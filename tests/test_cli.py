@@ -1,0 +1,99 @@
+"""CLI driver tests (reference demo/binary_classification/runexp.sh flow:
+train with a config file, continue training, pred, dump, eval)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from xgboost_tpu.cli import main as cli_main
+
+
+def _write_libsvm(path, X, y):
+    with open(path, "w") as f:
+        for row, label in zip(X, y):
+            feats = " ".join(f"{j}:{v:.6f}" for j, v in enumerate(row))
+            f.write(f"{label:g} {feats}\n")
+
+
+@pytest.fixture()
+def svm_data(tmp_path):
+    rng = np.random.RandomState(7)
+    X = rng.rand(400, 6).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] > 1.0)).astype(np.float32)
+    train = tmp_path / "train.svm"
+    test = tmp_path / "test.svm"
+    _write_libsvm(train, X[:300], y[:300])
+    _write_libsvm(test, X[300:], y[300:])
+    return tmp_path, str(train), str(test), y[300:]
+
+
+def _conf(tmp_path, train, test, **kw):
+    lines = {
+        "task": "train", "booster": "gbtree",
+        "objective": "binary:logistic", "eta": "0.5", "max_depth": "3",
+        "num_round": "5", "data": train, "eval[test]": test,
+        "model_out": str(tmp_path / "final.model"), "silent": "1",
+    }
+    lines.update(kw)
+    conf = tmp_path / "run.conf"
+    conf.write_text("".join(f"{k} = {v}\n" for k, v in lines.items()))
+    return str(conf)
+
+
+def test_cli_train_pred_eval_dump(svm_data, tmp_path, capsys):
+    tp, train, test, y_test = svm_data
+    conf = _conf(tp, train, test)
+    assert cli_main([conf]) == 0
+    model = str(tp / "final.model")
+    assert os.path.exists(model)
+
+    pred_file = str(tp / "pred.txt")
+    assert cli_main([conf, "task=pred", f"test:data={test}",
+                     f"model_in={model}", f"name_pred={pred_file}"]) == 0
+    preds = np.loadtxt(pred_file)
+    assert preds.shape == (100,)
+    acc = ((preds > 0.5) == (y_test > 0.5)).mean()
+    assert acc > 0.9
+
+    dump_file = str(tp / "dump.txt")
+    assert cli_main([conf, "task=dump", f"model_in={model}",
+                     f"name_dump={dump_file}"]) == 0
+    text = open(dump_file).read()
+    assert "booster[0]" in text and "leaf=" in text
+
+    assert cli_main([conf, "task=eval", f"model_in={model}"]) == 0
+
+
+def test_cli_continue_training(svm_data, tmp_path):
+    tp, train, test, _ = svm_data
+    m1 = str(tp / "m1.model")
+    conf = _conf(tp, train, test, num_round="3", model_out=m1)
+    assert cli_main([conf]) == 0
+    m2 = str(tp / "m2.model")
+    assert cli_main([conf, f"model_in={m1}", f"model_out={m2}",
+                     "num_round=2"]) == 0
+    import xgboost_tpu as xgb
+    bst = xgb.Booster(model_file=m2)
+    assert bst.gbtree.num_trees == 5
+
+
+def test_cli_save_period_and_checkpoint_resume(svm_data, tmp_path):
+    tp, train, test, _ = svm_data
+    ckpt = str(tp / "ckpt")
+    conf = _conf(tp, train, test, num_round="4", save_period="2",
+                 model_dir=str(tp), checkpoint_dir=ckpt)
+    assert cli_main([conf]) == 0
+    assert os.path.exists(tp / "0002.model")
+    assert os.path.exists(tp / "0004.model")
+    # newest two checkpoints kept
+    kept = sorted(os.listdir(ckpt))
+    assert kept == ["ckpt-000003.model", "ckpt-000004.model"]
+
+    # "kill" after round 4 of 6: rerun with num_round=6 resumes from ckpt 4
+    conf6 = _conf(tp, train, test, num_round="6", checkpoint_dir=ckpt,
+                  model_out=str(tp / "resumed.model"))
+    assert cli_main([conf6]) == 0
+    import xgboost_tpu as xgb
+    bst = xgb.Booster(model_file=str(tp / "resumed.model"))
+    assert bst.gbtree.num_trees == 6

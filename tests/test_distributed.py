@@ -98,3 +98,36 @@ def test_graft_entry_forward():
     fn, args = ge.entry()
     out = jax.jit(fn)(*args)
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_dp_custom_objective_odd_rows(mesh8):
+    """Custom-objective (fobj) path under row sharding with padding:
+    predictions seen by fobj must have exactly num_row entries and the
+    padded gradient rows must not perturb the model."""
+    X, y = make_data(n=4091)
+    d = xgb.DMatrix(X, label=y)
+
+    def logistic_obj(preds, dmat):
+        labels = dmat.get_label()
+        assert preds.shape == (4091,)
+        grad = preds - labels
+        hess = preds * (1.0 - preds)
+        return grad, hess
+
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.5,
+              "dsplit": "row"}
+    bst = xgb.train(params, d, 3, obj=logistic_obj, verbose_eval=False)
+    bst_builtin = xgb.train(params, xgb.DMatrix(X, label=y), 3,
+                            verbose_eval=False)
+    np.testing.assert_allclose(bst.predict(d),
+                               bst_builtin.predict(xgb.DMatrix(X, label=y)),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_dp_pred_leaf_truncates_padding(mesh8):
+    X, y = make_data(n=4091)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "dsplit": "row"}, d, 2, verbose_eval=False)
+    leaves = bst.predict(d, pred_leaf=True)
+    assert leaves.shape[0] == 4091

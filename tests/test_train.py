@@ -162,3 +162,26 @@ def test_determinism(agaricus):
     p1 = xgb.train(params, dtrain, 3, verbose_eval=False).predict(dtrain)
     p2 = xgb.train(params, dtrain, 3, verbose_eval=False).predict(dtrain)
     np.testing.assert_array_equal(p1, p2)
+
+
+def test_scale_pos_weight_survives_model_reload(tmp_path):
+    """Continued training after load_model must keep objective-side params
+    (scale_pos_weight et al.) that live in the saved header."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(500, 5).astype(np.float32)
+    y = (X[:, 0] > 0.8).astype(np.float32)  # imbalanced
+    d = xgb.DMatrix(X, label=y)
+    params = {"objective": "binary:logistic", "scale_pos_weight": 5.0,
+              "max_depth": 3, "eta": 0.3}
+    bst = xgb.train(params, d, 2, verbose_eval=False)
+    path = str(tmp_path / "spw.model")
+    bst.save_model(path)
+
+    bst2 = xgb.Booster(model_file=path)
+    assert bst2.obj.scale_pos_weight == 5.0
+    bst2.update(d, 2)  # continued training uses the weighted gradient
+    bst_ref = xgb.train(params, xgb.DMatrix(X, label=y), 3,
+                        verbose_eval=False)
+    np.testing.assert_allclose(bst2.predict(d),
+                               bst_ref.predict(xgb.DMatrix(X, label=y)),
+                               rtol=2e-4, atol=2e-5)

@@ -1,0 +1,7 @@
+"""Entry point: ``python -m xgboost_tpu <config> [name=value ...]``."""
+
+import sys
+
+from xgboost_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,290 @@
+"""Command-line driver: ``python -m xgboost_tpu <config> [name=value ...]``.
+
+Mirrors the reference CLI (``src/xgboost_main.cpp:19-323``): a config
+file of ``name = value`` pairs plus command-line overrides, dispatching
+``task=train|pred|eval|dump``.  Parameter names are kept identical
+(``num_round``, ``save_period``, ``model_in``, ``model_out``,
+``model_dir``, ``eval[name]=path``, ``test:data``, ``name_pred``,
+``pred_margin``, ``ntree_limit``, ``fmap``, ``name_dump``,
+``dump_stats``, ``eval_train``, ``dsplit``).
+
+Fault tolerance: where the reference wraps the round loop in rabit
+checkpoints (``xgboost_main.cpp:175-229``, two versions per round), this
+driver checkpoints the model to ``checkpoint_dir`` after every round and
+resumes from the newest checkpoint on restart (SURVEY.md §5.3 TPU
+mapping: per-round model checkpoint + restartable loop keyed by round
+version; collectives themselves are not elastically recoverable
+mid-step under XLA).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from xgboost_tpu.config import parse_config_file
+
+
+class BoostLearnTask:
+    """Training/prediction task state (reference BoostLearnTask)."""
+
+    def __init__(self):
+        self.silent = 0
+        self.use_buffer = 1
+        self.num_round = 10
+        self.save_period = 0
+        self.eval_train = 0
+        self.pred_margin = 0
+        self.ntree_limit = 0
+        self.dump_stats = 0
+        self.task = "train"
+        self.train_path = ""
+        self.test_path = ""
+        self.model_in: Optional[str] = None
+        self.model_out: Optional[str] = None
+        self.save_final = True  # model_out=NONE disables the final save
+        self.model_dir = "./"
+        self.name_fmap = ""
+        self.name_pred = "pred.txt"
+        self.name_dump = "dump.txt"
+        self.checkpoint_dir: Optional[str] = None
+        self.eval_names: List[str] = []
+        self.eval_paths: List[str] = []
+        self.learner_params: List[Tuple[str, str]] = []
+
+    # ------------------------------------------------------------- params
+    _OWN = {
+        "silent": int, "use_buffer": int, "num_round": int,
+        "save_period": int, "eval_train": int, "pred_margin": int,
+        "ntree_limit": int, "dump_stats": int,
+    }
+
+    def set_param(self, name: str, val: str) -> None:
+        if name in self._OWN:
+            setattr(self, name, self._OWN[name](val))
+        elif name == "task":
+            self.task = val
+        elif name == "data":
+            self.train_path = val
+        elif name == "test:data":
+            self.test_path = val
+        elif name == "model_in":
+            self.model_in = None if val == "NULL" else val
+        elif name == "model_out":
+            # NULL -> save numbered file; NONE -> skip the final save
+            # (reference xgboost_main.cpp:218-224)
+            self.model_out = None if val in ("NULL", "NONE") else val
+            self.save_final = val != "NONE"
+        elif name == "model_dir":
+            self.model_dir = val
+        elif name == "fmap":
+            self.name_fmap = "" if val == "NULL" else val
+        elif name == "name_dump":
+            self.name_dump = val
+        elif name == "name_pred":
+            self.name_pred = val
+        elif name == "checkpoint_dir":
+            self.checkpoint_dir = val
+        else:
+            m = re.match(r"eval\[([^\]]+)\]", name)
+            if m:
+                self.eval_names.append(m.group(1))
+                self.eval_paths.append(val)
+                return
+        # every param also cascades into the learner (reference
+        # xgboost_main.cpp:95 "learner.SetParam(name, val)")
+        self.learner_params.append((name, val))
+
+    # --------------------------------------------------------------- run
+    def run(self, argv: List[str]) -> int:
+        if not argv:
+            print("Usage: python -m xgboost_tpu <config> [name=value ...]")
+            return 0
+        if os.path.exists(argv[0]) or "=" not in argv[0]:
+            for name, val in parse_config_file(argv[0]):
+                self.set_param(name, val)
+            rest = argv[1:]
+        else:
+            rest = argv
+        for arg in rest:
+            name, eq, val = arg.partition("=")
+            if eq:
+                self.set_param(name, val)
+        if self.model_out == "stdout" or self.name_pred == "stdout":
+            self.set_param("silent", "1")
+            self.save_period = 0
+
+        if self.task == "train":
+            return self.task_train()
+        if self.task == "pred":
+            return self.task_pred()
+        if self.task == "eval":
+            return self.task_eval()
+        if self.task == "dump":
+            return self.task_dump()
+        raise ValueError(f"unknown task {self.task!r}")
+
+    # ------------------------------------------------------------- helpers
+    def _params_dict(self) -> Dict[str, str]:
+        d: Dict[str, str] = {}
+        metrics: List[str] = []
+        for k, v in self.learner_params:
+            if k == "eval_metric":
+                metrics.append(v)
+            else:
+                d[k] = v
+        if metrics:
+            d["eval_metric"] = metrics
+        return d
+
+    def _load_data(self, path: str):
+        from xgboost_tpu.data import DMatrix
+        return DMatrix(path, silent=self.silent != 0)
+
+    def _make_booster(self, cache=()):
+        from xgboost_tpu.learner import Booster
+        bst = Booster(self._params_dict(), cache=list(cache))
+        if self.model_in:
+            bst.load_model(self.model_in)
+            bst.set_param(self._params_dict())
+        return bst
+
+    def _save(self, bst, i: Optional[int] = None) -> None:
+        if i is None:
+            assert self.model_out is not None
+            path = self.model_out
+        else:
+            path = os.path.join(self.model_dir, f"{i + 1:04d}.model")
+        bst.save_model(path)
+
+    # ------------------------------------------------------------- train
+    def task_train(self) -> int:
+        import xgboost_tpu  # noqa: F401  (ensure package import works early)
+
+        data = self._load_data(self.train_path)
+        evals = [(self._load_data(p), n)
+                 for p, n in zip(self.eval_paths, self.eval_names)]
+        if self.eval_train:
+            evals.append((data, "train"))
+
+        bst = self._make_booster(cache=[data] + [d for d, _ in evals])
+        start_round = 0
+        if self.checkpoint_dir:
+            bst, start_round = _load_checkpoint(
+                self.checkpoint_dir, bst, self._params_dict())
+
+        start = time.time()
+        for i in range(start_round, self.num_round):
+            if not self.silent:
+                print(f"boosting round {i}, {time.time() - start:.0f} sec "
+                      "elapsed", file=sys.stderr)
+            bst.update(data, i)
+            if evals:
+                msg = bst.eval_set(evals, i)
+                if self.silent < 2:
+                    print(msg, file=sys.stderr)
+            if self.save_period != 0 and (i + 1) % self.save_period == 0:
+                self._save(bst, i)
+            if self.checkpoint_dir:
+                _save_checkpoint(self.checkpoint_dir, bst, i + 1)
+        # always save final round (reference xgboost_main.cpp:218-224)
+        if self.save_final and (self.save_period == 0
+                                or self.num_round % self.save_period != 0):
+            if self.model_out is not None:
+                self._save(bst)
+            else:
+                self._save(bst, self.num_round - 1)
+        elif self.save_final and self.model_out is not None:
+            self._save(bst)
+        if not self.silent:
+            print(f"\nupdating end, {time.time() - start:.0f} sec in all",
+                  file=sys.stderr)
+        return 0
+
+    # -------------------------------------------------------------- pred
+    def task_pred(self) -> int:
+        data = self._load_data(self.test_path)
+        bst = self._make_booster()
+        assert self.model_in, "model_in not specified"
+        if not self.silent:
+            print("start prediction...")
+        preds = bst.predict(data, output_margin=self.pred_margin != 0,
+                            ntree_limit=self.ntree_limit)
+        if not self.silent:
+            print(f"writing prediction to {self.name_pred}")
+        out = sys.stdout if self.name_pred == "stdout" else open(
+            self.name_pred, "w")
+        try:
+            for p in preds.reshape(-1):
+                out.write(f"{p:g}\n")
+        finally:
+            if out is not sys.stdout:
+                out.close()
+        return 0
+
+    # -------------------------------------------------------------- eval
+    def task_eval(self) -> int:
+        assert self.model_in, "model_in not specified"
+        evals = [(self._load_data(p), n)
+                 for p, n in zip(self.eval_paths, self.eval_names)]
+        bst = self._make_booster(cache=[d for d, _ in evals])
+        print(bst.eval_set(evals, 0), file=sys.stderr)
+        return 0
+
+    # -------------------------------------------------------------- dump
+    def task_dump(self) -> int:
+        assert self.model_in, "model_in not specified"
+        bst = self._make_booster()
+        dumps = bst.get_dump(self.name_fmap, with_stats=self.dump_stats != 0)
+        with open(self.name_dump, "w") as f:
+            for i, s in enumerate(dumps):
+                f.write(f"booster[{i}]:\n{s}")
+        return 0
+
+
+# -------------------------------------------------------- checkpointing
+def _ckpt_path(ckpt_dir: str, version: int) -> str:
+    return os.path.join(ckpt_dir, f"ckpt-{version:06d}.model")
+
+
+def _save_checkpoint(ckpt_dir: str, bst, version: int) -> None:
+    """Atomic per-round checkpoint (the rabit::CheckPoint analog — the
+    model is tiny, so a full save per round is cheap; SURVEY.md §5.3)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = _ckpt_path(ckpt_dir, version)
+    tmp = path + ".tmp"
+    bst.save_model(tmp)
+    os.replace(tmp, path)
+    # keep only the two most recent checkpoints (ring of replicas analog)
+    kept = sorted(f for f in os.listdir(ckpt_dir)
+                  if re.fullmatch(r"ckpt-\d{6}\.model", f))
+    for stale in kept[:-2]:
+        os.remove(os.path.join(ckpt_dir, stale))
+
+
+def _load_checkpoint(ckpt_dir: str, bst, params: dict):
+    """Resume from the newest checkpoint (rabit::LoadCheckPoint analog,
+    version 0 when none exists — reference xgboost_main.cpp:176-183)."""
+    if not os.path.isdir(ckpt_dir):
+        return bst, 0
+    found = sorted(f for f in os.listdir(ckpt_dir)
+                   if re.fullmatch(r"ckpt-\d{6}\.model", f))
+    if not found:
+        return bst, 0
+    version = int(found[-1][5:11])
+    bst.load_model(os.path.join(ckpt_dir, found[-1]))
+    bst.set_param(params)
+    return bst, version
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    task = BoostLearnTask()
+    task.set_param("seed", "0")
+    return task.run(list(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

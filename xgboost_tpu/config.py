@@ -102,8 +102,7 @@ class TrainParam:
         if name == "default_direction" and isinstance(value, str):
             value = {"learn": 0, "left": 1, "right": 2}.get(value, value)
         if name in self.field_names():
-            ftype = {f.name: f.type for f in dataclasses.fields(self)}[name]
-            setattr(self, name, _coerce(value, ftype, getattr(self, name)))
+            setattr(self, name, _coerce(value, getattr(self, name)))
         else:
             self.extras[name] = value
         return self
@@ -127,11 +126,9 @@ class TrainParam:
         return max(1, self.num_class)
 
 
-def _coerce(value: Any, ftype: Any, current: Any) -> Any:
-    """Coerce a (possibly string) value to the dataclass field's type."""
+def _coerce(value: Any, current: Any) -> Any:
+    """Coerce a (possibly string) value to the current field value's type."""
     target = type(current) if current is not None else str
-    if isinstance(ftype, str):
-        ftype = ftype.strip()
     if isinstance(value, str):
         if target is bool:
             return value.lower() in ("1", "true", "yes")

@@ -74,15 +74,18 @@ class Booster:
             self.param.set_param(name, value)
         self._reconfigure()
 
+    def _init_obj(self):
+        self.obj = create_objective(self.param.objective)
+        self.obj.set_param("scale_pos_weight", self.param.scale_pos_weight)
+        self.obj.set_param("num_class", self.param.num_class)
+        self.obj.set_param("num_pairsample", self.param.num_pairsample)
+        self.obj.set_param("fix_list_weight", self.param.fix_list_weight)
+
     def _reconfigure(self):
         """Propagate changed params into live objective/booster state, so
         continued training (xgb_model=...) honors new hyperparameters."""
         if self.obj is not None:
-            self.obj = create_objective(self.param.objective)
-            self.obj.set_param("scale_pos_weight", self.param.scale_pos_weight)
-            self.obj.set_param("num_class", self.param.num_class)
-            self.obj.set_param("num_pairsample", self.param.num_pairsample)
-            self.obj.set_param("fix_list_weight", self.param.fix_list_weight)
+            self._init_obj()
         if self.gbtree is not None and self.param.booster != "gblinear":
             from xgboost_tpu.models.gbtree import make_grow_config
             self.gbtree.param = self.param
@@ -92,11 +95,7 @@ class Booster:
     # ------------------------------------------------------------- init
     def _lazy_init(self, dtrain: DMatrix):
         if self.obj is None:
-            self.obj = create_objective(self.param.objective)
-            self.obj.set_param("scale_pos_weight", self.param.scale_pos_weight)
-            self.obj.set_param("num_class", self.param.num_class)
-            self.obj.set_param("num_pairsample", self.param.num_pairsample)
-            self.obj.set_param("fix_list_weight", self.param.fix_list_weight)
+            self._init_obj()
         if self.gbtree is None:
             if self.param.booster == "gblinear":
                 from xgboost_tpu.models.gblinear import GBLinear
@@ -199,7 +198,10 @@ class Booster:
             gh = self.obj.get_gradient(entry.margin, entry.info, iteration,
                                        entry.binned.shape[0])
         else:
+            # custom objective sees only the real rows; gradients are
+            # zero-padded back to the device row count below in boost()
             pred = np.asarray(self.obj.pred_transform(entry.margin))
+            pred = pred[:entry.n_real]
             if pred.shape[1] == 1:
                 pred = pred[:, 0]
             grad, hess = fobj(pred, dtrain)
@@ -214,6 +216,10 @@ class Booster:
         self._sync_margin(entry)
         g = np.asarray(grad, np.float32).reshape(dtrain.num_row, self._K)
         h = np.asarray(hess, np.float32).reshape(dtrain.num_row, self._K)
+        pad = entry.binned.shape[0] - dtrain.num_row
+        if pad:  # zero-gradient padding rows (dsplit=row sharding)
+            g = np.concatenate([g, np.zeros((pad, self._K), np.float32)])
+            h = np.concatenate([h, np.zeros((pad, self._K), np.float32)])
         gh = jnp.stack([jnp.asarray(g), jnp.asarray(h)], axis=-1)
         self._do_boost(dtrain, entry, gh, self.gbtree.num_boosted_rounds
                        if self.param.booster != "gblinear"
@@ -259,7 +265,8 @@ class Booster:
         else:
             binned, base = cached.binned, cached.base
         if pred_leaf:
-            return np.asarray(self.gbtree.predict_leaf(binned, ntree_limit))
+            leaves = np.asarray(self.gbtree.predict_leaf(binned, ntree_limit))
+            return leaves[:cached.n_real] if cached is not None else leaves
         if cached is not None and ntree_limit == 0:
             self._sync_margin(cached)
             margin = cached.margin
@@ -337,8 +344,7 @@ class Booster:
             self.attributes = header.get("attributes", {})
             self.best_iteration = header.get("best_iteration", -1)
             state = {k: z[k] for k in z.files if k != "header"}
-        self.obj = create_objective(self.param.objective)
-        self.obj.set_param("num_class", self.param.num_class)
+        self._init_obj()
         if self.param.booster == "gblinear":
             from xgboost_tpu.models.gblinear import GBLinear
             self.gbtree = GBLinear.from_state(self.param, state)
@@ -436,7 +442,6 @@ def train(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
     best_score = None
     best_iter = 0
     best_msg = ""
-    stopped_early = False
 
     for i in range(num_boost_round):
         bst.update(dtrain, i, fobj=obj)
@@ -463,7 +468,6 @@ def train(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
             elif i - best_iter >= early_stopping_rounds:
                 if verbose_eval:
                     print(f"Stopping. Best iteration:\n{best_msg}")
-                stopped_early = True
                 break
     if early_stopping_rounds is not None and best_score is not None:
         bst.best_score = best_score

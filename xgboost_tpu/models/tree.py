@@ -199,8 +199,8 @@ def _traverse_one(tree: TreeArrays, binned: jax.Array, max_depth: int):
     Matches reference RegTree::GetLeafIndex / GetNext (model.h:534-566)
     including missing-value default direction.
     """
-    N = binned.shape[0]
-    node = jnp.zeros(N, jnp.int32)
+    # derive from binned so the row sharding (dsplit=row) carries over
+    node = jnp.zeros_like(binned[:, 0], dtype=jnp.int32)
     for _ in range(max_depth):
         f = tree.feature[node]
         leaf = tree.is_leaf[node] | (f < 0)

@@ -34,8 +34,14 @@ def get_mesh() -> Optional[Mesh]:
 
 
 def data_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:
-    """1-D mesh over the first n (default all) devices, axis 'data'."""
+    """1-D mesh over the first n (default all) devices, axis 'data'.
+
+    Auto axis types: tree traversal gathers (replicated node tables,
+    row-sharded indices) rely on GSPMD propagation, which Explicit mode
+    rejects as ambiguous.
+    """
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
-    return jax.make_mesh((len(devs),), (DATA_AXIS,), devices=devs)
+    return jax.make_mesh((len(devs),), (DATA_AXIS,), devices=devs,
+                         axis_types=(jax.sharding.AxisType.Auto,))
