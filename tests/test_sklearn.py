@@ -1,0 +1,114 @@
+"""sklearn-API tests (reference demo/guide-python/sklearn_examples.py)."""
+
+import numpy as np
+import pytest
+
+from xgboost_tpu import XGBClassifier, XGBRegressor
+from xgboost_tpu.sklearn import SKLEARN_INSTALLED
+
+
+def _reg_data(n=600, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = 3.0 * X[:, 0] - 2.0 * X[:, 1] ** 2 + 0.1 * rng.randn(n)
+    return X, y.astype(np.float32)
+
+
+def test_regressor_fit_predict():
+    X, y = _reg_data()
+    model = XGBRegressor(n_estimators=20, max_depth=4, learning_rate=0.3)
+    model.fit(X[:500], y[:500])
+    pred = model.predict(X[500:])
+    mse = float(np.mean((pred - y[500:]) ** 2))
+    assert mse < 0.1
+    imp = model.feature_importances_
+    assert imp.shape == (8,) and abs(imp.sum() - 1.0) < 1e-5
+    assert imp[0] > 0.05  # the linear driver feature gets splits
+
+
+def test_binary_classifier_with_string_labels():
+    rng = np.random.RandomState(1)
+    X = rng.rand(500, 5).astype(np.float32)
+    y_raw = np.where(X[:, 0] + X[:, 1] > 1.0, "pos", "neg")
+    model = XGBClassifier(n_estimators=15, max_depth=3)
+    model.fit(X, y_raw)
+    pred = model.predict(X)
+    assert set(pred) <= {"pos", "neg"}
+    assert (pred == y_raw).mean() > 0.93
+    proba = model.predict_proba(X)
+    assert proba.shape == (500, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_multiclass_classifier_auto_switch():
+    rng = np.random.RandomState(2)
+    X = rng.rand(600, 6).astype(np.float32)
+    y = (X[:, 0] * 3).astype(int)  # 3 classes 0,1,2
+    model = XGBClassifier(n_estimators=10, max_depth=3)
+    model.fit(X, y)
+    # per-fit switch: the booster trains softprob, the estimator's own
+    # objective param is untouched (so clone()/refit stay clean)
+    assert model.get_booster().param.objective == "multi:softprob"
+    assert model.objective == "binary:logistic"
+    assert model.n_classes_ == 3
+    proba = model.predict_proba(X)
+    assert proba.shape == (600, 3)
+    assert (model.predict(X) == y).mean() > 0.9
+
+
+def test_early_stopping_and_eval_set():
+    X, y = _reg_data()
+    model = XGBRegressor(n_estimators=200, max_depth=3, learning_rate=0.3)
+    model.fit(X[:400], y[:400], eval_set=[(X[400:], y[400:])],
+              early_stopping_rounds=5)
+    assert hasattr(model, "best_iteration_")
+    assert model.best_iteration_ < 199
+    assert "validation_0-rmse" in model.evals_result_
+
+
+def test_get_set_params_roundtrip():
+    model = XGBRegressor(n_estimators=7, subsample=0.8)
+    p = model.get_params()
+    assert p["n_estimators"] == 7 and p["subsample"] == 0.8
+    model.set_params(max_depth=5)
+    assert model.max_depth == 5
+    with pytest.raises(ValueError):
+        model.set_params(bogus=1)
+
+
+@pytest.mark.skipif(not SKLEARN_INSTALLED, reason="sklearn not installed")
+def test_sklearn_cross_val_integration():
+    from sklearn.model_selection import cross_val_score
+    X, y = _reg_data(n=300)
+    scores = cross_val_score(
+        XGBRegressor(n_estimators=40, max_depth=3, learning_rate=0.3), X, y,
+        cv=3, scoring="neg_mean_squared_error")
+    assert scores.mean() > -0.2
+
+
+def test_apply_leaf_indices():
+    X, y = _reg_data(n=200)
+    model = XGBRegressor(n_estimators=5, max_depth=3).fit(X, y)
+    leaves = model.apply(X)
+    assert leaves.shape == (200, 5)
+
+
+def test_classifier_refit_binary_after_multiclass():
+    """Regression: a multiclass fit must not poison a later binary fit."""
+    rng = np.random.RandomState(4)
+    X3 = rng.rand(300, 4).astype(np.float32)
+    y3 = (X3[:, 0] * 3).astype(int)
+    X2 = rng.rand(300, 4).astype(np.float32)
+    y2 = (X2[:, 0] > 0.5).astype(int)
+    model = XGBClassifier(n_estimators=5, max_depth=3)
+    model.fit(X3, y3)
+    model.fit(X2, y2)
+    proba = model.predict_proba(X2)
+    assert proba.shape == (300, 2)
+    assert (model.predict(X2) == y2).mean() > 0.9
+
+
+def test_early_stopping_without_eval_set_raises():
+    X, y = _reg_data(n=100)
+    with pytest.raises(ValueError, match="early stopping"):
+        XGBRegressor(n_estimators=10).fit(X, y, early_stopping_rounds=3)

@@ -15,6 +15,7 @@ custom kernel is a Pallas histogram kernel; everything else is XLA.
 from xgboost_tpu.config import TrainParam
 from xgboost_tpu.data import DMatrix
 from xgboost_tpu.learner import Booster, train, cv
+from xgboost_tpu.sklearn import XGBModel, XGBClassifier, XGBRegressor
 
 __version__ = "0.1.0"
 
@@ -24,5 +25,8 @@ __all__ = [
     "Booster",
     "train",
     "cv",
+    "XGBModel",
+    "XGBClassifier",
+    "XGBRegressor",
     "__version__",
 ]
