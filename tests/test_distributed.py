@@ -131,3 +131,57 @@ def test_dp_pred_leaf_truncates_padding(mesh8):
                      "dsplit": "row"}, d, 2, verbose_eval=False)
     leaves = bst.predict(d, pred_leaf=True)
     assert leaves.shape[0] == 4091
+
+
+# ---------------------------------------------------------------- distcol
+def test_colsplit_matches_single_device():
+    """dsplit=col (DistColMaker analog): feature-sharded growth must
+    reproduce the single-device model exactly — the SplitEntry argmax
+    reduce and psum position bitmap change nothing numerically."""
+    from xgboost_tpu.parallel.colsplit import feature_parallel_mesh
+
+    X, y = make_data(n=2048, f=10)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.5}
+
+    d1 = xgb.DMatrix(X, label=y)
+    bst_single = xgb.train(params, d1, 4, verbose_eval=False)
+    p_single = bst_single.predict(d1)
+
+    d2 = xgb.DMatrix(X, label=y)
+    bst_col = xgb.train({**params, "dsplit": "col"}, d2, 4,
+                        verbose_eval=False)
+    p_col = bst_col.predict(d2)
+    np.testing.assert_allclose(p_single, p_col, rtol=2e-4, atol=2e-5)
+
+    # identical tree structure, not just predictions (cut_index is only
+    # meaningful on real split nodes; elsewhere it holds argmax noise)
+    for t1, t2 in zip(bst_single.gbtree.trees, bst_col.gbtree.trees):
+        f1, f2 = np.asarray(t1.feature), np.asarray(t2.feature)
+        np.testing.assert_array_equal(f1, f2)
+        split = f1 >= 0
+        np.testing.assert_array_equal(np.asarray(t1.cut_index)[split],
+                                      np.asarray(t2.cut_index)[split])
+
+
+def test_colsplit_feature_count_not_divisible():
+    """F=13 features over 8 shards exercises the feature-padding path."""
+    X, y = make_data(n=1024, f=13, seed=3)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "eta": 0.5, "dsplit": "col"}, d, 3,
+                    evals=[(d, "train")], verbose_eval=False)
+    err = ((bst.predict(d) > 0.5) != (y > 0.5)).mean()
+    assert err < 0.1
+
+
+def test_colsplit_with_gamma_prune():
+    X, y = make_data(n=1024, f=10, seed=4)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                     "eta": 0.5, "gamma": 0.3, "dsplit": "col"}, d, 2,
+                    verbose_eval=False)
+    d_s = xgb.DMatrix(X, label=y)
+    bst_s = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                       "eta": 0.5, "gamma": 0.3}, d_s, 2, verbose_eval=False)
+    np.testing.assert_allclose(bst.predict(d), bst_s.predict(d_s),
+                               rtol=2e-4, atol=2e-5)

@@ -82,6 +82,45 @@ def compute_cuts(dmat: DMatrix, max_bin: int = 256, sketch_eps: float = 0.03,
     return CutMatrix(cut_values, n_cuts)
 
 
+def compute_cuts_exact(dmat: DMatrix, max_exact_bin: int = 4096) -> CutMatrix:
+    """Cuts at EVERY distinct feature value — exact greedy as quantization.
+
+    Enumerating a split before each distinct value is the same candidate
+    set as the reference's sorted-column forward scan
+    (``updater_colmaker-inl.hpp:362-414``); the sequential scan itself
+    does not vectorize, but with cuts at all distinct values the
+    histogram updater enumerates the identical partitions (only the
+    recorded threshold differs: the reference stores a midpoint, we store
+    the distinct value).  Features with more than ``max_exact_bin``
+    distinct values fall back to that many quantile cuts.
+    """
+    F = dmat.num_col
+    per_feature = []
+    max_cuts = 1
+    for f in range(F):
+        _, vals = dmat.column_values(f)
+        uniq = np.unique(vals)
+        if len(uniq) > max_exact_bin:
+            cuts = propose_cuts(
+                prune_summary(make_summary(vals), 2 * max_exact_bin),
+                max_exact_bin)
+        else:
+            # every distinct value is a cut, INCLUDING the minimum: the
+            # "v < min" split separates nothing among present values but
+            # with the learned default direction it is the
+            # missing-vs-present split — essential for sparse indicator
+            # features (all-ones columns in libsvm one-hot data)
+            cuts = uniq.astype(np.float32)
+        per_feature.append(cuts)
+        max_cuts = max(max_cuts, len(cuts))
+    cut_values = np.full((F, max_cuts), np.inf, dtype=np.float32)
+    n_cuts = np.zeros(F, dtype=np.int32)
+    for f, cuts in enumerate(per_feature):
+        cut_values[f, :len(cuts)] = cuts
+        n_cuts[f] = len(cuts)
+    return CutMatrix(cut_values, n_cuts)
+
+
 def bin_matrix(dmat: DMatrix, cuts: CutMatrix) -> np.ndarray:
     """Quantize to a dense (n_rows, F) bin-id array (0 = missing)."""
     n, F = dmat.num_row, cuts.num_feature

@@ -58,6 +58,8 @@ class TrainParam:
     # -- gbtree params (reference src/gbm/gbtree-inl.hpp:389-428) --
     num_parallel_tree: int = 1
     updater: str = "grow_histmaker,prune"
+    # exact-greedy (grow_colmaker) cap on distinct values per feature
+    max_exact_bin: int = 4096
 
     # -- learner params (reference src/learner/learner-inl.hpp) --
     booster: str = "gbtree"  # gbtree | gblinear

@@ -61,6 +61,7 @@ class Booster:
         self.best_score: float = float("nan")
         self.attributes: Dict[str, str] = {}
         self._mesh = None                  # resolved at _lazy_init (dsplit=row)
+        self._col_mesh = None              # resolved at _lazy_init (dsplit=col)
         self._pending_cache = list(cache)  # bound at _lazy_init (needs cuts)
         if model_file is not None:
             self.load_model(model_file)
@@ -103,15 +104,30 @@ class Booster:
                 self.gbtree = GBLinear(self.param, dtrain.num_col)
             else:
                 from xgboost_tpu.models.gbtree import GBTree
+                from xgboost_tpu.models.updaters import parse_updaters
                 self.num_feature = dtrain.num_col
-                cuts = compute_cuts(dtrain, self.param.max_bin,
-                                    self.param.sketch_eps,
-                                    self.param.sketch_ratio)
+                if "grow_colmaker" in parse_updaters(self.param.updater):
+                    # exact greedy: cuts at every distinct value (under
+                    # dsplit=col this is the distributed exact mode — the
+                    # reference's DistColMaker extends ColMaker)
+                    from xgboost_tpu.binning import compute_cuts_exact
+                    cuts = compute_cuts_exact(dtrain,
+                                              self.param.max_exact_bin)
+                else:
+                    cuts = compute_cuts(dtrain, self.param.max_bin,
+                                        self.param.sketch_eps,
+                                        self.param.sketch_ratio)
                 self.gbtree = GBTree(self.param, cuts)
-        if self.param.dsplit == "row" and self._mesh is None \
-                and self.param.booster != "gblinear":
+        if self.param.booster != "gblinear":
             from xgboost_tpu.parallel import mesh as pmesh
-            self._mesh = pmesh.get_mesh() or pmesh.data_parallel_mesh()
+            if self.param.dsplit == "row" and self._mesh is None:
+                self._mesh = pmesh.get_mesh() or pmesh.data_parallel_mesh()
+            elif self.param.dsplit == "col" and self._col_mesh is None:
+                from xgboost_tpu.parallel.colsplit import feature_parallel_mesh
+                m = pmesh.get_mesh()
+                self._col_mesh = (m if m is not None
+                                  and "feat" in m.axis_names
+                                  else feature_parallel_mesh())
         for d in self._pending_cache:
             self._entry(d)
         self._pending_cache = []
@@ -142,6 +158,12 @@ class Booster:
                 self._cache[key] = self._make_sharded_entry(dmat)
             else:
                 binned = jnp.asarray(bin_matrix(dmat, self.gbtree.cuts))
+                if self._col_mesh is not None:
+                    # pad the feature axis ONCE per matrix (padding per
+                    # boosting round would re-copy the whole matrix)
+                    from xgboost_tpu.parallel.colsplit import pad_features
+                    binned = pad_features(
+                        binned, self._col_mesh.devices.size, axis=1)
                 self._cache[key] = _CacheEntry(
                     dmat, binned, self._base_margin_of(dmat, dmat.num_row))
         return self._cache[key]
@@ -237,11 +259,35 @@ class Booster:
             entry.margin = None
             self._sync_margin(entry)
             return
-        _, delta = self.gbtree.do_boost(entry.binned, gh, key,
-                                        row_valid=entry.row_valid,
-                                        mesh=self._mesh)
-        entry.margin = entry.margin + delta
-        entry.applied = self.gbtree.num_trees
+        from xgboost_tpu.models.updaters import parse_updaters
+        ups = parse_updaters(self.param.updater)
+        grows = any(u.startswith("grow") or u == "distcol" for u in ups)
+        if grows:
+            _, delta = self.gbtree.do_boost(entry.binned, gh, key,
+                                            row_valid=entry.row_valid,
+                                            mesh=self._mesh,
+                                            col_mesh=self._col_mesh)
+            entry.margin = entry.margin + delta
+            entry.applied = self.gbtree.num_trees
+        if "refresh" in ups:
+            # refresh pass (reference updater=refresh): recompute stats +
+            # leaf values of ALL trees on this data.  In a mixed pipeline
+            # ("grow_histmaker,refresh") it runs after growth on the same
+            # gradient snapshot, like the reference's sequential updaters.
+            self.gbtree.do_refresh(entry.binned, gh,
+                                   row_valid=entry.row_valid,
+                                   mesh=self._mesh)
+            if "prune" in ups and self.param.gamma > 0.0 and not grows:
+                # "refresh,prune": prune against the refreshed gains
+                from xgboost_tpu.models.updaters import prune_tree
+                for i, t in enumerate(self.gbtree.trees):
+                    self.gbtree.trees[i], _ = prune_tree(t, self.param.gamma)
+                self.gbtree._stack_cache = None
+            # leaf values changed: every cached margin is stale
+            for e in self._cache.values():
+                e.margin = None
+                e.applied = 0
+            self._sync_margin(entry)
 
     # ------------------------------------------------------------ inference
     def predict(self, data: DMatrix, output_margin: bool = False,

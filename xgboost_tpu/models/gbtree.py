@@ -50,6 +50,19 @@ class GBTree:
         self._stack_cache: Optional[Tuple[int, TreeArrays, jax.Array]] = None
         self.cut_values_dev = jnp.asarray(cuts.cut_values)
         self.n_cuts_dev = jnp.asarray(cuts.n_cuts)
+        self._col_pad_cache = None  # (n_shard, cut_values, n_cuts)
+
+    def col_arrays(self, n_shard: int):
+        """Cut arrays feature-padded to the column mesh (cached: padding
+        the same arrays every boosting round is wasted HBM traffic)."""
+        if self._col_pad_cache is None or self._col_pad_cache[0] != n_shard:
+            from xgboost_tpu.parallel.colsplit import pad_features
+            self._col_pad_cache = (
+                n_shard,
+                pad_features(self.cut_values_dev, n_shard, axis=0,
+                             fill=jnp.inf),
+                pad_features(self.n_cuts_dev, n_shard, axis=0))
+        return self._col_pad_cache[1], self._col_pad_cache[2]
 
     @property
     def num_trees(self) -> int:
@@ -64,15 +77,22 @@ class GBTree:
     # ---------------------------------------------------------------- boost
     def do_boost(self, binned: jax.Array, gh: jax.Array, key: jax.Array,
                  row_valid: Optional[jax.Array] = None,
-                 mesh=None) -> Tuple[List[TreeArrays], jax.Array]:
+                 mesh=None, col_mesh=None) -> Tuple[List[TreeArrays], jax.Array]:
         """One boosting round: grows num_output_group × num_parallel_tree
-        trees (reference BoostNewTrees, gbtree-inl.hpp:238-273).
+        trees (reference BoostNewTrees, gbtree-inl.hpp:238-273), then runs
+        the prune updater if configured (reference updater pipeline
+        "grow_histmaker,prune", gbtree-inl.hpp:218-236).
 
         gh: (N, K, 2).  Returns (new_trees, leaf_contrib (N, K) margin delta)
         computed from grow-time leaf positions — the prediction-buffer fast
-        path (gbtree-inl.hpp:258-303).  With a mesh, rows are sharded over
-        the 'data' axis and histograms psum-reduced (SURVEY.md §5.8).
+        path (gbtree-inl.hpp:258-303).  With `mesh`, rows are sharded over
+        the 'data' axis and histograms psum-reduced (SURVEY.md §5.8); with
+        `col_mesh`, features are sharded over 'feat' (DistColMaker).
         """
+        from xgboost_tpu.models.updaters import parse_updaters, prune_tree
+
+        do_prune = ("prune" in parse_updaters(self.param.updater)
+                    and self.param.gamma > 0.0)
         K = max(1, self.param.num_output_group)
         npar = max(1, self.param.num_parallel_tree)
         new_trees: List[TreeArrays] = []
@@ -81,7 +101,18 @@ class GBTree:
             delta_k = None
             for t in range(npar):
                 tkey = jax.random.fold_in(key, k * npar + t)
-                if mesh is not None:
+                if col_mesh is not None:
+                    from xgboost_tpu.parallel.colsplit import (
+                        grow_tree_colsplit, pad_features)
+                    n_shard = col_mesh.devices.size
+                    cv, nc = self.col_arrays(n_shard)
+                    if binned.shape[1] % n_shard:  # caller didn't pre-pad
+                        binned = pad_features(binned, n_shard, axis=1)
+                    tree, row_leaf, d = grow_tree_colsplit(
+                        col_mesh, tkey, binned, gh[:, k, :], cv, nc,
+                        self.cfg, row_valid,
+                        f_real=self.cuts.num_feature)
+                elif mesh is not None:
                     from xgboost_tpu.parallel.dp import grow_tree_dp
                     rv = row_valid if row_valid is not None else \
                         jnp.ones(binned.shape[0], jnp.bool_)
@@ -92,6 +123,11 @@ class GBTree:
                     tree, row_leaf = grow_tree(
                         tkey, binned, gh[:, k, :], self.cut_values_dev,
                         self.n_cuts_dev, self.cfg, row_valid)
+                    d = None
+                if do_prune:
+                    tree, resolve = prune_tree(tree, self.param.gamma)
+                    d = tree.leaf_value[jnp.asarray(resolve)[row_leaf]]
+                elif d is None:
                     d = tree.leaf_value[row_leaf]
                 new_trees.append(tree)
                 self.trees.append(tree)
@@ -100,6 +136,28 @@ class GBTree:
             deltas.append(delta_k)
         self._stack_cache = None
         return new_trees, jnp.stack(deltas, axis=1)
+
+    # --------------------------------------------------------------- refresh
+    def do_refresh(self, binned: jax.Array, gh: jax.Array,
+                   row_valid: Optional[jax.Array] = None, mesh=None) -> None:
+        """Refresh all trees' stats/leaf values on (new) data — the
+        reference's ``updater=refresh`` continued-training mode
+        (updater_refresh-inl.hpp:19-151)."""
+        from xgboost_tpu.models.updaters import refresh_tree
+
+        if mesh is not None:
+            from xgboost_tpu.parallel.dp import refresh_tree_dp
+        for i, tree in enumerate(self.trees):
+            k = self.tree_group[i]
+            if mesh is not None:
+                self.trees[i] = refresh_tree_dp(
+                    mesh, tree, binned, gh[:, k, :], self.cfg.split,
+                    self.cfg.max_depth, row_valid)
+            else:
+                self.trees[i] = refresh_tree(
+                    tree, binned, gh[:, k, :], self.cfg.split,
+                    self.cfg.max_depth, row_valid)
+        self._stack_cache = None
 
     # -------------------------------------------------------------- predict
     def _stack(self, ntree_limit: int = 0):

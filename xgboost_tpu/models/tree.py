@@ -52,26 +52,67 @@ class GrowConfig(NamedTuple):
     colsample_bylevel: float = 1.0
 
 
+class SplitDecision(NamedTuple):
+    """Per-node chosen split for one level (hook-neutral: `feature` is in
+    whatever id space the finder uses — local on one chip, global under
+    column sharding — and `owner` names the shard holding the feature)."""
+    gain: jax.Array          # (n_node,) f32
+    feature: jax.Array       # (n_node,) int32
+    cut_index: jax.Array     # (n_node,) int32
+    default_left: jax.Array  # (n_node,) bool
+    threshold: jax.Array     # (n_node,) f32 raw cut value
+    valid: jax.Array         # (n_node,) bool
+    owner: jax.Array         # (n_node,) int32 shard owning the feature
+
+
+def _default_split_finder(hist, nst, n_cuts, cut_values, fmask, split_cfg):
+    """Single-shard split finding: all features are local."""
+    best = find_best_splits(hist, nst, n_cuts, split_cfg, fmask)
+    thr = cut_values[best.feature, best.cut_index]
+    return SplitDecision(best.gain, best.feature, best.cut_index,
+                         best.default_left, thr, best.valid,
+                         jnp.zeros_like(best.feature))
+
+
+def _default_router(best: SplitDecision, node_of_row, binned):
+    """Row go-left decision when the split feature's bins are local."""
+    f_row = best.feature[node_of_row]
+    j_row = best.cut_index[node_of_row]
+    dl_row = best.default_left[node_of_row]
+    b = jnp.take_along_axis(binned.astype(jnp.int32),
+                            f_row[:, None], axis=1)[:, 0]
+    return jnp.where(b == 0, dl_row, b <= j_row + 1)
+
+
+def _default_feat_sampler(key, rate, binned):
+    return _sample_features(key, binned.shape[1], rate)
+
+
 def tree_capacity(max_depth: int) -> int:
     return 2 ** (max_depth + 1) - 1
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "hist_reduce"))
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "hist_reduce", "split_finder", "router", "feat_sampler"))
 def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
               cut_values: jax.Array, n_cuts: jax.Array, cfg: GrowConfig,
               row_valid: Optional[jax.Array] = None,
-              hist_reduce: Callable[[jax.Array], jax.Array] = None):
+              hist_reduce: Callable[[jax.Array], jax.Array] = None,
+              split_finder=None, router=None, feat_sampler=None):
     """Grow one tree level-by-level.
 
     Args:
       key: PRNG key for row/column subsampling.
-      binned: (N, F) bin ids (0 = missing).
+      binned: (N, F) bin ids (0 = missing); F may be a feature SHARD.
       gh: (N, 2) gradient pairs.
       cut_values: (F, C) padded raw cut values, n_cuts: (F,).
       row_valid: optional (N,) bool — rows that belong to this shard/set
         (padding rows excluded from both stats and leaf assignment).
       hist_reduce: collective reduction applied to every histogram and
         node-stat tensor (identity when None; psum over 'data' in DP mode).
+      split_finder/router/feat_sampler: the collective seams for
+        column-split training (parallel/colsplit.py); the defaults are
+        the single-shard implementations.
 
     Returns (tree: TreeArrays, row_leaf: (N,) int32 global leaf node per row).
     """
@@ -79,6 +120,12 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
     D = cfg.max_depth
     n_total = tree_capacity(D)
     red = hist_reduce if hist_reduce is not None else (lambda x: x)
+    if split_finder is None:
+        split_finder = _default_split_finder
+    if router is None:
+        router = _default_router
+    if feat_sampler is None:
+        feat_sampler = _default_feat_sampler
 
     key_rows, key_ftree, key_flevel = jax.random.split(key, 3)
 
@@ -95,7 +142,7 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
     # column sampling bytree (colmaker-inl.hpp:148-160): boolean mask, no
     # replacement semantics approximated by per-feature bernoulli with a
     # guaranteed non-empty fallback.
-    feat_mask_tree = _sample_features(key_ftree, F, cfg.colsample_bytree)
+    feat_mask_tree = feat_sampler(key_ftree, cfg.colsample_bytree, binned)
 
     tree = TreeArrays(
         feature=jnp.full(n_total, -1, jnp.int32),
@@ -127,10 +174,11 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
                                              n_node, cfg.n_bin))
             fmask = feat_mask_tree
             if cfg.colsample_bylevel < 1.0:
-                fmask = fmask & _sample_features(
-                    jax.random.fold_in(key_flevel, depth), F,
-                    cfg.colsample_bylevel)
-            best = find_best_splits(hist, nst, n_cuts, cfg.split, fmask)
+                fmask = fmask & feat_sampler(
+                    jax.random.fold_in(key_flevel, depth),
+                    cfg.colsample_bylevel, binned)
+            best = split_finder(hist, nst, n_cuts, cut_values, fmask,
+                                cfg.split)
             # cannot_split (param.h:174): too little hessian mass to split
             can_try = nst[:, 1] >= 2.0 * cfg.split.min_child_weight
             do_split = best.valid & can_try
@@ -142,22 +190,23 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
         live = (nst[:, 1] > 0.0) | (jnp.arange(n_node) == 0) if depth == 0 \
             else (nst[:, 1] > 0.0)
 
+        # the would-be leaf weight is recorded for EVERY live node (not just
+        # leaves): the prune updater turns split nodes back into leaves and
+        # needs their weight (reference keeps base_weight in RTreeNodeStat)
         leaf_w = calc_weight(nst[:, 0], nst[:, 1], cfg.split) * cfg.split.eta
         idx = base + jnp.arange(n_node)
         tree = tree._replace(
             sum_hess=tree.sum_hess.at[idx].set(nst[:, 1]),
             is_leaf=tree.is_leaf.at[idx].set(make_leaf & live),
-            leaf_value=tree.leaf_value.at[idx].set(
-                jnp.where(make_leaf, leaf_w, 0.0)),
+            leaf_value=tree.leaf_value.at[idx].set(leaf_w),
         )
         if best is not None:
-            thr = cut_values[best.feature, best.cut_index]
             keep_split = ~make_leaf
             tree = tree._replace(
                 feature=tree.feature.at[idx].set(
                     jnp.where(keep_split, best.feature, -1)),
                 cut_index=tree.cut_index.at[idx].set(best.cut_index),
-                threshold=tree.threshold.at[idx].set(thr),
+                threshold=tree.threshold.at[idx].set(best.threshold),
                 default_left=tree.default_left.at[idx].set(best.default_left),
                 gain=tree.gain.at[idx].set(
                     jnp.where(keep_split, best.gain, 0.0)),
@@ -169,12 +218,7 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
         row_is_leaf = active & make_leaf[node_of_row]
         row_leaf = jnp.where(row_is_leaf, base + pos, row_leaf)
         if best is not None:
-            f_row = best.feature[node_of_row]              # (N,)
-            j_row = best.cut_index[node_of_row]
-            dl_row = best.default_left[node_of_row]
-            b = jnp.take_along_axis(binned.astype(jnp.int32),
-                                    f_row[:, None], axis=1)[:, 0]
-            go_left = jnp.where(b == 0, dl_row, b <= j_row + 1)
+            go_left = router(best, node_of_row, binned)
             new_pos = 2 * pos + (~go_left).astype(jnp.int32)
             pos = jnp.where(active & ~row_is_leaf, new_pos, -1)
 

@@ -1,0 +1,153 @@
+"""Tree updaters beyond growth: prune, refresh, and the updater registry.
+
+The reference exposes seven pluggable ``IUpdater`` names
+(``src/tree/updater.cpp:18-31``).  Their TPU-native mapping:
+
+  - ``grow_colmaker``  — exact greedy: realized as histogram growth with
+    cuts at EVERY distinct feature value (partition-equivalent to the
+    sorted-column scan of ``updater_colmaker-inl.hpp:362-414``).
+  - ``grow_histmaker`` — quantile-binned histogram growth (the default;
+    ``updater_histmaker-inl.hpp``).
+  - ``grow_skmaker``   — per-node sketch approximation; subsumed by the
+    histogram path here (same approximation family).
+  - ``prune``          — bottom-up post-prune of splits with
+    loss_chg < min_split_loss (``updater_prune-inl.hpp:42-72``).
+  - ``refresh``        — recompute node stats/leaf values by streaming
+    (new) data through the existing trees
+    (``updater_refresh-inl.hpp:19-151``).
+  - ``distcol``        — column-split distributed growth
+    (:mod:`xgboost_tpu.parallel.colsplit`;
+    ``updater_distcol-inl.hpp``).
+  - ``sync``           — broadcast trees from rank 0
+    (``updater_sync-inl.hpp:34-49``); a no-op here because every shard
+    computes identical trees from psum-reduced statistics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xgboost_tpu.models.tree import TreeArrays
+from xgboost_tpu.ops.split import SplitConfig, calc_gain, calc_weight
+
+KNOWN_UPDATERS = ("grow_colmaker", "grow_histmaker", "grow_skmaker",
+                  "prune", "refresh", "distcol", "sync")
+
+
+def parse_updaters(updater: str) -> Tuple[str, ...]:
+    seq = tuple(u.strip() for u in updater.split(",") if u.strip())
+    for u in seq:
+        if u not in KNOWN_UPDATERS:
+            raise ValueError(f"unknown updater {u!r} (known: {KNOWN_UPDATERS})")
+    return seq
+
+
+# ------------------------------------------------------------------- prune
+def prune_tree(tree: TreeArrays, gamma: float) -> Tuple[TreeArrays, np.ndarray]:
+    """Bottom-up post-prune (reference TreePruner::TryPruneLeaf,
+    updater_prune-inl.hpp:42-72): a split node whose children are both
+    leaves and whose loss_chg < gamma becomes a leaf, recursively.
+
+    Host-side numpy — trees are tiny.  Returns (pruned tree,
+    resolve[n_nodes] mapping every node to its surviving self-or-ancestor
+    leaf so grow-time row->leaf assignments can be re-targeted).
+    """
+    feature = np.asarray(tree.feature).copy()
+    is_leaf = np.asarray(tree.is_leaf).copy()
+    gain = np.asarray(tree.gain).copy()
+    n = feature.shape[0]
+
+    def leaf_like(c: int) -> bool:
+        return c >= n or is_leaf[c] or feature[c] < 0
+
+    # deepest-first sweep = recursion order of the reference
+    for nid in range(n - 1, -1, -1):
+        if is_leaf[nid] or feature[nid] < 0:
+            continue
+        left, right = 2 * nid + 1, 2 * nid + 2
+        if leaf_like(left) and leaf_like(right) and gain[nid] < gamma:
+            is_leaf[nid] = True
+            feature[nid] = -1
+            gain[nid] = 0.0
+
+    resolve = np.arange(n, dtype=np.int32)
+    # top-down: a node under a pruned ancestor resolves to that ancestor
+    for nid in range(1, n):
+        parent = (nid - 1) // 2
+        if is_leaf[resolve[parent]] or feature[resolve[parent]] < 0:
+            resolve[nid] = resolve[parent]
+
+    pruned = tree._replace(
+        feature=jnp.asarray(feature),
+        is_leaf=jnp.asarray(is_leaf),
+        gain=jnp.asarray(gain),
+    )
+    return pruned, resolve
+
+
+# ----------------------------------------------------------------- refresh
+@functools.partial(jax.jit, static_argnames=("cfg", "max_depth", "hist_reduce"))
+def refresh_tree(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
+                 cfg: SplitConfig, max_depth: int,
+                 row_valid: Optional[jax.Array] = None,
+                 hist_reduce: Callable[[jax.Array], jax.Array] = None
+                 ) -> TreeArrays:
+    """Recompute one tree's node stats + leaf values from (new) data
+    (reference TreeRefresher, updater_refresh-inl.hpp:19-151: stream rows
+    through the tree accumulating GradStats at every node on the path,
+    allreduce, then refresh leaf values and loss_chg).
+
+    Structure (features/thresholds) is untouched; leaf_value, sum_hess
+    and gain are refreshed.  The gradients gh must be computed against
+    the margin EXCLUDING this tree (the reference refreshes trees one by
+    one, subtracting each tree's contribution first) — the caller handles
+    that; for the common single-refresh-pass use the full-model margin is
+    the reference's behavior too (it refreshes all trees against the
+    current prediction).
+    """
+    red = hist_reduce if hist_reduce is not None else (lambda x: x)
+    n_nodes = tree.n_nodes
+    gh_used = gh
+    if row_valid is not None:
+        gh_used = gh_used * row_valid[:, None].astype(gh.dtype)
+
+    # accumulate (G, H) at every node on each row's root->leaf path
+    node = jnp.zeros_like(binned[:, 0], dtype=jnp.int32)
+    acc = jnp.zeros((n_nodes, 2), jnp.float32)
+    for _ in range(max_depth + 1):
+        acc = acc.at[node].add(gh_used)
+        f = tree.feature[node]
+        leaf = tree.is_leaf[node] | (f < 0)
+        b = jnp.take_along_axis(binned.astype(jnp.int32),
+                                jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        go_left = jnp.where(b == 0, tree.default_left[node],
+                            b <= tree.cut_index[node] + 1)
+        node = jnp.where(leaf, node, jnp.where(go_left, 2 * node + 1,
+                                               2 * node + 2))
+        # a row parked at a leaf has contributed at every path node
+        # including the leaf itself; zero it out for later iterations
+        gh_used = jnp.where(leaf[:, None], 0.0, gh_used)
+    acc = red(acc)
+
+    G, H = acc[:, 0], acc[:, 1]
+    new_weight = calc_weight(G, H, cfg) * cfg.eta
+    # refreshed loss_chg for split nodes: gain(L) + gain(R) - gain(self)
+    left = jnp.arange(n_nodes) * 2 + 1
+    right = left + 1
+    GL = jnp.where(left < n_nodes, G[jnp.clip(left, 0, n_nodes - 1)], 0.0)
+    HL = jnp.where(left < n_nodes, H[jnp.clip(left, 0, n_nodes - 1)], 0.0)
+    GR = jnp.where(right < n_nodes, G[jnp.clip(right, 0, n_nodes - 1)], 0.0)
+    HR = jnp.where(right < n_nodes, H[jnp.clip(right, 0, n_nodes - 1)], 0.0)
+    split_gain = (calc_gain(GL, HL, cfg) + calc_gain(GR, HR, cfg)
+                  - calc_gain(G, H, cfg))
+    is_split = (~tree.is_leaf) & (tree.feature >= 0)
+    return tree._replace(
+        leaf_value=new_weight,
+        sum_hess=H,
+        gain=jnp.where(is_split, split_gain, 0.0),
+    )

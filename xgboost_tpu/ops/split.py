@@ -119,8 +119,10 @@ def find_best_splits(hist: jax.Array, nstats: jax.Array, n_cuts: jax.Array,
     feature = (best // (C * 2)).astype(jnp.int32)
     cut_index = ((best // 2) % C).astype(jnp.int32)
     default_left = (best % 2).astype(jnp.bool_)
-    # accept: positive reduction and survives pre-prune by gamma
-    # (reference: loss_chg > rt_eps at histmaker-inl.hpp:253, then the prune
-    #  updater removes loss_chg < min_split_loss, updater_prune-inl.hpp:42-72)
-    valid = (best_gain > RT_EPS) & (best_gain >= cfg.gamma)
+    # accept: positive reduction (reference loss_chg > rt_eps,
+    # histmaker-inl.hpp:253).  gamma is NOT applied here: the prune updater
+    # post-prunes loss_chg < min_split_loss bottom-up
+    # (updater_prune-inl.hpp:42-72), which keeps a weak split whose
+    # descendants are strong — pre-pruning would not.
+    valid = best_gain > RT_EPS
     return BestSplit(best_gain, feature, cut_index, default_left, valid)

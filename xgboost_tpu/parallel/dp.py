@@ -49,6 +49,27 @@ def grow_tree_dp(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
     return fn(key, binned, gh, cut_values, n_cuts, row_valid)
 
 
+def refresh_tree_dp(mesh: Mesh, tree, binned, gh, split_cfg, max_depth,
+                    row_valid):
+    """Refresh a tree's stats over row-sharded data: per-shard path
+    accumulation + psum (exactly the reference TreeRefresher's lazy
+    allreduce of all node stats, updater_refresh-inl.hpp:94-98)."""
+    from xgboost_tpu.models.updaters import refresh_tree
+
+    def body(tree, binned, gh, row_valid):
+        return refresh_tree(tree, binned, gh, split_cfg, max_depth,
+                            row_valid, hist_reduce=_psum_data)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+    if row_valid is None:
+        row_valid = jnp.ones(binned.shape[0], jnp.bool_)
+    return fn(tree, binned, gh, row_valid)
+
+
 def shard_rows(mesh: Mesh, arr: jax.Array) -> jax.Array:
     """Place an array with rows sharded over the 'data' axis."""
     spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))

@@ -124,6 +124,10 @@ class XGBModel(BaseEstimator):
         if early_stopping_rounds is not None and not eval_set:
             raise ValueError(
                 "For early stopping you need at least one set in eval_set")
+        # drop stale early-stopping state from a previous fit
+        for attr in ("best_score_", "best_iteration_"):
+            if hasattr(self, attr):
+                delattr(self, attr)
         labels, extra_params, trans = self._encode_labels(y)
         params = {**self.get_xgb_params(), **extra_params}
         dtrain = self._dmatrix(X, labels, sample_weight)
