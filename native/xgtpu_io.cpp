@@ -1,0 +1,442 @@
+// xgtpu_io: native IO runtime for xgboost_tpu.
+//
+// TPU-native counterpart of the reference's host-side IO machinery:
+//   - multithreaded libsvm text parsing (reference src/io/libsvm_parser.h:
+//     chunked parsing split at line boundaries, there with OpenMP; here
+//     std::thread over byte ranges with a line-count prefix pass so
+//     rank/npart row sharding is deterministic).
+//   - external-memory sparse page store with a background prefetch
+//     thread (reference src/io/sparse_batch_page.h page format +
+//     src/utils/thread_buffer.h double-buffer producer).
+//
+// Exposed as a C ABI consumed via ctypes (xgboost_tpu/native.py).
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread (see Makefile).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kPageMagic = 0xFFAB7C02D1E5F00DULL;
+
+struct CSRChunk {
+  std::vector<int64_t> row_ptr;   // local, starts at 0
+  std::vector<int32_t> indices;
+  std::vector<float> values;
+  std::vector<float> labels;
+  bool error = false;             // malformed input seen
+};
+
+struct CSRResult {
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> indices;
+  std::vector<float> values;
+  std::vector<float> labels;
+};
+
+// ------------------------------------------------------------------ parse
+inline const char* parse_float(const char* p, const char* /*end*/,
+                               float* out) {
+  // Returns the RAW stop position (may exceed the line end when strtof
+  // skips the newline into the next line — callers treat that as a
+  // malformed-input error rather than clamping it away).
+  char* q;
+  *out = strtof(p, &q);
+  return q;
+}
+
+void parse_range(const char* data, size_t begin, size_t end_,
+                 int64_t first_line, int rank, int nparts, CSRChunk* out) {
+  const char* p = data + begin;
+  const char* end = data + end_;
+  int64_t line = first_line;
+  while (p < end) {
+    const char* eol = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (eol == nullptr) eol = end;
+    bool keep = (nparts <= 1) || (line % nparts == rank);
+    if (keep) {
+      // skip blank lines
+      const char* q = p;
+      while (q < eol && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+      if (q < eol) {
+        float label;
+        const char* after = parse_float(q, eol, &label);
+        if (after == q || after > eol) {  // unparseable label
+          out->error = true;
+          return;
+        }
+        q = after;
+        out->labels.push_back(label);
+        while (q < eol) {
+          while (q < eol && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+          if (q >= eol) break;
+          // malformed tokens are hard errors, matching the Python
+          // fallback which raises ValueError on int()/float() failure
+          char* colon;
+          long idx = strtol(q, &colon, 10);
+          if (colon == q || colon >= eol || *colon != ':' ||
+              colon + 1 >= eol) {
+            out->error = true;
+            return;
+          }
+          float v;
+          after = parse_float(colon + 1, eol, &v);
+          if (after == colon + 1 || after > eol) {  // empty/cross-line value
+            out->error = true;
+            return;
+          }
+          q = after;
+          out->indices.push_back(static_cast<int32_t>(idx));
+          out->values.push_back(v);
+        }
+        out->row_ptr.push_back(static_cast<int64_t>(out->indices.size()));
+      }
+    }
+    ++line;
+    p = eol + 1;
+  }
+}
+
+// status: 0 ok, 1 cannot open/read, 2 malformed input
+CSRResult* parse_libsvm(const char* path, int nthread, int rank, int nparts,
+                        int* status) {
+  std::vector<CSRChunk> chunks;
+  {
+    // scope the file buffer: freed before the merge so peak memory is
+    // chunks + result, not buffer + chunks + result
+    std::ifstream f(path, std::ios::binary | std::ios::ate);
+    if (!f) {
+      *status = 1;
+      return nullptr;
+    }
+    size_t size = static_cast<size_t>(f.tellg());
+    f.seekg(0);
+    std::string buf(size, '\0');
+    if (size && !f.read(&buf[0], static_cast<std::streamsize>(size))) {
+      *status = 1;
+      return nullptr;
+    }
+
+    if (nthread <= 0)
+      nthread = static_cast<int>(std::thread::hardware_concurrency());
+    if (nthread < 1) nthread = 1;
+    if (size < (1u << 16)) nthread = 1;  // small file: no parallel win
+
+    // chunk boundaries aligned to line starts
+    std::vector<size_t> bounds{0};
+    for (int t = 1; t < nthread; ++t) {
+      size_t target = size * static_cast<size_t>(t) / nthread;
+      const void* nl = memchr(buf.data() + target, '\n', size - target);
+      size_t b = nl ? static_cast<size_t>(static_cast<const char*>(nl) -
+                                          buf.data()) + 1
+                    : size;
+      if (b <= bounds.back()) b = bounds.back();
+      bounds.push_back(b);
+    }
+    bounds.push_back(size);
+
+    // prefix pass: global line index at each chunk start, so rank/npart
+    // sharding picks exactly the rows `line % nparts == rank` regardless
+    // of thread count (deterministic split loading,
+    // reference simple_dmatrix-inl.hpp:89-96)
+    std::vector<int64_t> first_line(bounds.size() - 1, 0);
+    {
+      int64_t acc = 0;
+      for (size_t c = 0; c + 1 < bounds.size(); ++c) {
+        first_line[c] = acc;
+        const char* p = buf.data() + bounds[c];
+        const char* end = buf.data() + bounds[c + 1];
+        while (p < end) {
+          const void* nl = memchr(p, '\n', static_cast<size_t>(end - p));
+          if (!nl) { ++acc; break; }
+          ++acc;
+          p = static_cast<const char*>(nl) + 1;
+        }
+      }
+    }
+
+    chunks.resize(static_cast<size_t>(nthread));
+    std::vector<std::thread> workers;
+    for (int t = 0; t < nthread; ++t) {
+      workers.emplace_back(parse_range, buf.data(), bounds[t], bounds[t + 1],
+                           first_line[t], rank, nparts, &chunks[t]);
+    }
+    for (auto& w : workers) w.join();
+  }
+
+  for (const auto& c : chunks) {
+    if (c.error) {
+      *status = 2;
+      return nullptr;
+    }
+  }
+
+  auto* res = new CSRResult();
+  size_t n_rows = 0, nnz = 0;
+  for (const auto& c : chunks) {
+    n_rows += c.labels.size();
+    nnz += c.indices.size();
+  }
+  res->indptr.reserve(n_rows + 1);
+  res->indptr.push_back(0);
+  res->indices.reserve(nnz);
+  res->values.reserve(nnz);
+  res->labels.reserve(n_rows);
+  for (auto& c : chunks) {
+    int64_t base = res->indptr.back();
+    for (int64_t rp : c.row_ptr) res->indptr.push_back(base + rp);
+    res->indices.insert(res->indices.end(), c.indices.begin(),
+                        c.indices.end());
+    res->values.insert(res->values.end(), c.values.begin(), c.values.end());
+    res->labels.insert(res->labels.end(), c.labels.begin(), c.labels.end());
+    c = CSRChunk();  // release each chunk as it is merged
+  }
+  *status = 0;
+  return res;
+}
+
+// ------------------------------------------------------------- page store
+struct Page {
+  std::vector<int64_t> indptr;  // n_rows + 1
+  std::vector<int32_t> indices;
+  std::vector<float> values;
+  int64_t n_rows() const { return static_cast<int64_t>(indptr.size()) - 1; }
+};
+
+struct PageWriter {
+  std::ofstream out;
+  explicit PageWriter(const char* path) : out(path, std::ios::binary) {
+    out.write(reinterpret_cast<const char*>(&kPageMagic), sizeof(kPageMagic));
+  }
+  bool push(int64_t n_rows, const int64_t* indptr, const int32_t* indices,
+            const float* values) {
+    int64_t n_entries = indptr[n_rows] - indptr[0];
+    out.write(reinterpret_cast<const char*>(&n_rows), 8);
+    out.write(reinterpret_cast<const char*>(&n_entries), 8);
+    // rebased indptr
+    std::vector<int64_t> rebased(static_cast<size_t>(n_rows) + 1);
+    for (int64_t i = 0; i <= n_rows; ++i) rebased[static_cast<size_t>(i)] =
+        indptr[i] - indptr[0];
+    out.write(reinterpret_cast<const char*>(rebased.data()),
+              static_cast<std::streamsize>(8 * (n_rows + 1)));
+    out.write(reinterpret_cast<const char*>(indices + indptr[0]),
+              static_cast<std::streamsize>(4 * n_entries));
+    out.write(reinterpret_cast<const char*>(values + indptr[0]),
+              static_cast<std::streamsize>(4 * n_entries));
+    return static_cast<bool>(out);
+  }
+};
+
+// Background prefetch reader: producer thread keeps one page ahead
+// (the reference's ThreadBuffer<Page> double buffer, thread_buffer.h).
+struct PageReader {
+  std::ifstream in;
+  std::thread producer;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unique_ptr<Page> ready;      // produced, not yet consumed
+  std::unique_ptr<Page> current;    // handed to consumer
+  bool eof = false;
+  bool stop = false;
+  bool do_reset = false;
+  bool ok = false;
+
+  explicit PageReader(const char* path) : in(path, std::ios::binary) {
+    uint64_t magic = 0;
+    in.read(reinterpret_cast<char*>(&magic), sizeof(magic));
+    if (!in || magic != kPageMagic) {
+      eof = true;
+      return;
+    }
+    ok = true;
+    producer = std::thread([this] { this->run(); });
+  }
+
+  ~PageReader() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    if (producer.joinable()) producer.join();
+  }
+
+  std::unique_ptr<Page> read_one() {
+    auto p = std::make_unique<Page>();
+    int64_t n_rows = 0, n_entries = 0;
+    if (!in.read(reinterpret_cast<char*>(&n_rows), 8)) return nullptr;
+    if (!in.read(reinterpret_cast<char*>(&n_entries), 8)) return nullptr;
+    p->indptr.resize(static_cast<size_t>(n_rows) + 1);
+    p->indices.resize(static_cast<size_t>(n_entries));
+    p->values.resize(static_cast<size_t>(n_entries));
+    if (!in.read(reinterpret_cast<char*>(p->indptr.data()),
+                 static_cast<std::streamsize>(8 * (n_rows + 1))))
+      return nullptr;
+    if (n_entries) {
+      if (!in.read(reinterpret_cast<char*>(p->indices.data()),
+                   static_cast<std::streamsize>(4 * n_entries)))
+        return nullptr;
+      if (!in.read(reinterpret_cast<char*>(p->values.data()),
+                   static_cast<std::streamsize>(4 * n_entries)))
+        return nullptr;
+    }
+    return p;
+  }
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu);
+    for (;;) {
+      if (stop) return;
+      if (do_reset) {
+        in.clear();
+        in.seekg(static_cast<std::streamoff>(sizeof(kPageMagic)));
+        eof = false;
+        ready.reset();
+        do_reset = false;
+        cv.notify_all();
+        continue;
+      }
+      if (!eof && ready == nullptr) {
+        lk.unlock();                       // read without holding the lock
+        std::unique_ptr<Page> p = read_one();
+        lk.lock();
+        if (stop || do_reset) continue;    // discard the stale read
+        if (!p)
+          eof = true;
+        else
+          ready = std::move(p);
+        cv.notify_all();
+        continue;
+      }
+      cv.wait(lk, [this] {
+        return stop || do_reset || (!eof && ready == nullptr);
+      });
+    }
+  }
+
+  // returns the next page or nullptr at EOF
+  bool next() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [this] { return ready != nullptr || eof; });
+    if (!ready) {
+      current.reset();
+      return false;
+    }
+    current = std::move(ready);
+    cv.notify_all();  // wake producer to prefetch the next page
+    return true;
+  }
+
+  void reset() {
+    std::unique_lock<std::mutex> lk(mu);
+    do_reset = true;
+    current.reset();
+    cv.notify_all();
+    cv.wait(lk, [this] { return !do_reset || stop; });
+  }
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------ C ABI
+extern "C" {
+
+// status out-param: 0 ok, 1 cannot open/read, 2 malformed input
+void* XGTParseLibSVM(const char* path, int nthread, int rank, int nparts,
+                     int* status) {
+  return parse_libsvm(path, nthread, rank, nparts, status);
+}
+
+void XGTCSRSizes(void* handle, int64_t* n_rows, int64_t* n_entries) {
+  auto* r = static_cast<CSRResult*>(handle);
+  *n_rows = static_cast<int64_t>(r->labels.size());
+  *n_entries = static_cast<int64_t>(r->indices.size());
+}
+
+void XGTCSRCopy(void* handle, int64_t* indptr, int32_t* indices,
+                float* values, float* labels) {
+  auto* r = static_cast<CSRResult*>(handle);
+  memcpy(indptr, r->indptr.data(), 8 * r->indptr.size());
+  if (!r->indices.empty()) {
+    memcpy(indices, r->indices.data(), 4 * r->indices.size());
+    memcpy(values, r->values.data(), 4 * r->values.size());
+  }
+  if (!r->labels.empty())
+    memcpy(labels, r->labels.data(), 4 * r->labels.size());
+}
+
+void XGTCSRFree(void* handle) { delete static_cast<CSRResult*>(handle); }
+
+void* XGTPageWriterCreate(const char* path) {
+  auto* w = new PageWriter(path);
+  if (!w->out) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int XGTPageWriterPush(void* handle, int64_t n_rows, const int64_t* indptr,
+                      const int32_t* indices, const float* values) {
+  return static_cast<PageWriter*>(handle)->push(n_rows, indptr, indices,
+                                                values)
+             ? 0
+             : -1;
+}
+
+void XGTPageWriterClose(void* handle) {
+  delete static_cast<PageWriter*>(handle);
+}
+
+void* XGTPageReaderCreate(const char* path) {
+  auto* r = new PageReader(path);
+  if (!r->ok) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+// 1 = have page, 0 = EOF
+int XGTPageReaderNext(void* handle, int64_t* n_rows, int64_t* n_entries) {
+  auto* r = static_cast<PageReader*>(handle);
+  if (!r->next()) {
+    *n_rows = 0;
+    *n_entries = 0;
+    return 0;
+  }
+  *n_rows = r->current->n_rows();
+  *n_entries = static_cast<int64_t>(r->current->indices.size());
+  return 1;
+}
+
+void XGTPageReaderCopy(void* handle, int64_t* indptr, int32_t* indices,
+                       float* values) {
+  auto* r = static_cast<PageReader*>(handle);
+  Page* p = r->current.get();
+  memcpy(indptr, p->indptr.data(), 8 * p->indptr.size());
+  if (!p->indices.empty()) {
+    memcpy(indices, p->indices.data(), 4 * p->indices.size());
+    memcpy(values, p->values.data(), 4 * p->values.size());
+  }
+}
+
+void XGTPageReaderReset(void* handle) {
+  static_cast<PageReader*>(handle)->reset();
+}
+
+void XGTPageReaderFree(void* handle) {
+  delete static_cast<PageReader*>(handle);
+}
+
+}  // extern "C"

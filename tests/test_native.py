@@ -1,0 +1,154 @@
+"""Native IO runtime tests (native/xgtpu_io.cpp via ctypes): parser
+parity with the pure-Python path, rank/npart split loading, page store
+round-trip with prefetch."""
+
+import numpy as np
+import pytest
+
+from xgboost_tpu import native
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native IO runtime not built")
+
+
+def _write_libsvm(path, n=500, f=12, seed=0, sparsity=0.4):
+    rng = np.random.RandomState(seed)
+    lines = []
+    for i in range(n):
+        label = rng.randint(0, 2)
+        feats = [f"{j}:{rng.rand():.6f}" for j in range(f)
+                 if rng.rand() > sparsity]
+        lines.append(f"{label} {' '.join(feats)}")
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+from xgboost_tpu.data import parse_libsvm_python as _python_parse
+
+
+def test_native_parser_matches_python(tmp_path):
+    path = _write_libsvm(tmp_path / "a.svm")
+    got = native.parse_libsvm_native(path)
+    want = _python_parse(path)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_native_parser_multithreaded_deterministic(tmp_path):
+    path = _write_libsvm(tmp_path / "big.svm", n=20000, f=30, seed=1)
+    one = native.parse_libsvm_native(path, nthread=1)
+    many = native.parse_libsvm_native(path, nthread=7)
+    for a, b in zip(one, many):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_native_parser_rank_split(tmp_path):
+    path = _write_libsvm(tmp_path / "s.svm", n=1003)
+    shards = [native.parse_libsvm_native(path, rank=r, nparts=3)
+              for r in range(3)]
+    want = [_python_parse(path, rank=r, nparts=3) for r in range(3)]
+    total = 0
+    for got, exp in zip(shards, want):
+        for g, w in zip(got, exp):
+            np.testing.assert_array_equal(g, w)
+        total += len(got[3])
+    assert total == 1003
+
+
+def test_native_parser_missing_file():
+    with pytest.raises(IOError):
+        native.parse_libsvm_native("/nonexistent/x.svm")
+
+
+def test_page_store_roundtrip(tmp_path):
+    rng = np.random.RandomState(2)
+    pages = []
+    for _ in range(5):
+        n = rng.randint(10, 50)
+        counts = rng.randint(0, 6, n)
+        indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        nnz = int(indptr[-1])
+        pages.append((indptr, rng.randint(0, 100, nnz).astype(np.int32),
+                      rng.rand(nnz).astype(np.float32)))
+
+    path = str(tmp_path / "pages.bin")
+    with native.PageWriter(path) as w:
+        for p in pages:
+            w.push(*p)
+
+    with native.PageReader(path) as r:
+        got = list(r)
+        assert len(got) == 5
+        for (gi, gx, gv), (wi, wx, wv) in zip(got, pages):
+            np.testing.assert_array_equal(gi, wi)
+            np.testing.assert_array_equal(gx, wx)
+            np.testing.assert_array_equal(gv, wv)
+        # reset re-reads from the start
+        r.reset()
+        again = list(r)
+        assert len(again) == 5
+        np.testing.assert_array_equal(again[0][0], pages[0][0])
+
+
+def test_page_store_nonzero_base_indptr(tmp_path):
+    """Pages pushed from a slice of a larger CSR (indptr not starting at
+    0) must be rebased on disk."""
+    indptr = np.array([100, 103, 107], np.int64)
+    indices = np.zeros(200, np.int32)
+    values = np.zeros(200, np.float32)
+    indices[100:107] = np.arange(7)
+    values[100:107] = np.arange(7) * 0.5
+    path = str(tmp_path / "p.bin")
+    with native.PageWriter(path) as w:
+        w.push(indptr, indices, values)
+    with native.PageReader(path) as r:
+        gi, gx, gv = next(r)
+        np.testing.assert_array_equal(gi, [0, 3, 7])
+        np.testing.assert_array_equal(gx, np.arange(7))
+        np.testing.assert_allclose(gv, np.arange(7) * 0.5)
+
+
+def test_page_reader_bad_file(tmp_path):
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"not a page file")
+    with pytest.raises(IOError):
+        native.PageReader(str(bad))
+
+
+def test_dmatrix_uses_native_parser(tmp_path):
+    """DMatrix(path) must produce identical data through the native path."""
+    import xgboost_tpu as xgb
+    path = _write_libsvm(tmp_path / "d.svm", n=300, f=8, seed=3)
+    d = xgb.DMatrix(path)
+    want = _python_parse(path)
+    np.testing.assert_array_equal(d.indptr, want[0])
+    np.testing.assert_array_equal(d.indices, want[1])
+    np.testing.assert_array_equal(d.values, want[2])
+    np.testing.assert_array_equal(d.get_label(), want[3])
+
+
+def test_native_parser_malformed_raises(tmp_path):
+    """Malformed tokens must raise like the Python fallback, not be
+    silently skipped or read across lines."""
+    bad1 = tmp_path / "b1.svm"
+    bad1.write_text("1 3:\n0 5:1.0\n")  # empty value at end of line
+    with pytest.raises(ValueError):
+        native.parse_libsvm_native(str(bad1))
+    bad2 = tmp_path / "b2.svm"
+    bad2.write_text("1 7 2:1.0\n")  # token without colon
+    with pytest.raises(ValueError):
+        native.parse_libsvm_native(str(bad2))
+
+
+def test_native_parser_missing_raises_filenotfound():
+    with pytest.raises(FileNotFoundError):
+        native.parse_libsvm_native("/nonexistent/x.svm")
+
+
+def test_page_writer_empty_indptr_raises(tmp_path):
+    w = native.PageWriter(str(tmp_path / "e.bin"))
+    with pytest.raises(ValueError):
+        w.push(np.array([], np.int64), np.array([], np.int32),
+               np.array([], np.float32))
+    w.close()

@@ -248,9 +248,23 @@ def parse_libsvm(path: str, rank: int = 0, nparts: int = 1):
 
     The reference splits a text source across workers at load time
     (``simple_dmatrix-inl.hpp:89-96``); here ``rank``/``nparts`` select a
-    contiguous byte-range-free row shard (row i kept iff i % nparts == rank).
+    row shard (row i kept iff i % nparts == rank).
     Returns (indptr, indices, values, labels).
+
+    Uses the native multithreaded parser (native/xgtpu_io.cpp — the
+    reference's OMP chunk parser, ``src/io/libsvm_parser.h``) when
+    available; the pure-Python path below is the fallback.
     """
+    from xgboost_tpu.native import parse_libsvm_native
+    out = parse_libsvm_native(path, rank, nparts)
+    if out is not None:
+        return out
+    return parse_libsvm_python(path, rank, nparts)
+
+
+def parse_libsvm_python(path: str, rank: int = 0, nparts: int = 1):
+    """Pure-Python libsvm parser (fallback + parity oracle for the
+    native parser's tests)."""
     labels = []
     indptr = [0]
     indices: list = []
