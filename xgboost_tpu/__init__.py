@@ -14,6 +14,7 @@ custom kernel is a Pallas histogram kernel; everything else is XLA.
 
 from xgboost_tpu.config import TrainParam
 from xgboost_tpu.data import DMatrix
+from xgboost_tpu.external import ExtMemDMatrix
 from xgboost_tpu.learner import Booster, train, cv
 from xgboost_tpu.sklearn import XGBModel, XGBClassifier, XGBRegressor
 
@@ -22,6 +23,7 @@ __version__ = "0.1.0"
 __all__ = [
     "TrainParam",
     "DMatrix",
+    "ExtMemDMatrix",
     "Booster",
     "train",
     "cv",

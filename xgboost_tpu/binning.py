@@ -61,7 +61,6 @@ def compute_cuts(dmat: DMatrix, max_bin: int = 256, sketch_eps: float = 0.03,
     """
     F = dmat.num_col
     per_feature = []
-    max_cuts = 1
     for f in range(F):
         rows, vals = dmat.column_values(f)
         w = None if hess_weights is None else hess_weights[rows]
@@ -73,7 +72,13 @@ def compute_cuts(dmat: DMatrix, max_bin: int = 256, sketch_eps: float = 0.03,
                 max(2, int(sketch_ratio / max(sketch_eps, 1.0 / max_bin))))
         cuts = propose_cuts(summary, max_bin - 1)  # leave room for missing bin
         per_feature.append(cuts)
-        max_cuts = max(max_cuts, len(cuts))
+    return pack_cuts(per_feature)
+
+
+def pack_cuts(per_feature) -> CutMatrix:
+    """Pack per-feature cut lists into an inf-padded rectangular CutMatrix."""
+    F = len(per_feature)
+    max_cuts = max(1, max((len(c) for c in per_feature), default=1))
     cut_values = np.full((F, max_cuts), np.inf, dtype=np.float32)
     n_cuts = np.zeros(F, dtype=np.int32)
     for f, cuts in enumerate(per_feature):
@@ -96,7 +101,6 @@ def compute_cuts_exact(dmat: DMatrix, max_exact_bin: int = 4096) -> CutMatrix:
     """
     F = dmat.num_col
     per_feature = []
-    max_cuts = 1
     for f in range(F):
         _, vals = dmat.column_values(f)
         uniq = np.unique(vals)
@@ -112,13 +116,7 @@ def compute_cuts_exact(dmat: DMatrix, max_exact_bin: int = 4096) -> CutMatrix:
             # features (all-ones columns in libsvm one-hot data)
             cuts = uniq.astype(np.float32)
         per_feature.append(cuts)
-        max_cuts = max(max_cuts, len(cuts))
-    cut_values = np.full((F, max_cuts), np.inf, dtype=np.float32)
-    n_cuts = np.zeros(F, dtype=np.int32)
-    for f, cuts in enumerate(per_feature):
-        cut_values[f, :len(cuts)] = cuts
-        n_cuts[f] = len(cuts)
-    return CutMatrix(cut_values, n_cuts)
+    return pack_cuts(per_feature)
 
 
 def bin_matrix(dmat: DMatrix, cuts: CutMatrix) -> np.ndarray:

@@ -141,6 +141,11 @@ class BoostLearnTask:
         return d
 
     def _load_data(self, path: str):
+        if path.startswith("ext:"):
+            # external-memory matrix (reference's paged DMatrix via the
+            # #cachefile convention, io.cpp:20-29)
+            from xgboost_tpu.external import ExtMemDMatrix
+            return ExtMemDMatrix(path[4:], silent=self.silent != 0)
         from xgboost_tpu.data import DMatrix
         return DMatrix(path, silent=self.silent != 0)
 

@@ -1,0 +1,342 @@
+"""External-memory training: datasets larger than device HBM.
+
+The reference streams 64MB CSR pages from disk with a prefetch thread
+and routes all paged training through the histogram updater
+(``src/io/page_dmatrix-inl.hpp``, ``learner-inl.hpp:263-267``).  The
+TPU-native shape of the same idea (SURVEY.md §5.7):
+
+  1. ingest once into raw CSR pages on disk (native page store,
+     ``native/xgtpu_io.cpp``; in-RAM fallback);
+  2. one streaming pass builds per-feature quantile sketches
+     (merge/prune bounds identical to the in-RAM path) → cuts;
+  3. one streaming pass quantizes to a binned ``(N, F)`` small-int
+     **memmap** — the only O(N·F) artifact, living on disk/page cache,
+     never fully resident;
+  4. per tree level, batches of binned rows are staged host→device,
+     positions recomputed by partial traversal, and partial histograms
+     accumulated — working set is one batch (the reference builds
+     histograms col-batch by col-batch for the same reason,
+     ``updater_histmaker-inl.hpp:296-348``).
+
+Margins, labels and gradients are (N,)-sized and stay in host RAM.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import tempfile
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xgboost_tpu.data import DMatrix, MetaInfo, load_meta_sidecars
+from xgboost_tpu.models.tree import (GrowConfig, TreeArrays, _traverse_one,
+                                     apply_level, empty_tree)
+from xgboost_tpu.ops.histogram import build_level_histogram, node_stats
+from xgboost_tpu.ops.split import find_best_splits
+from xgboost_tpu.sketch import (QuantileSummary, empty_summary, make_summary,
+                                merge_summaries, prune_summary, propose_cuts)
+from xgboost_tpu.binning import CutMatrix
+
+DEFAULT_PAGE_ROWS = 1 << 16
+
+
+class ExtMemDMatrix:
+    """Paged data matrix (reference DMatrixPage, magic 0xffffab02).
+
+    Construct from a libsvm path (``ExtMemDMatrix("big.svm#cache")`` or
+    ``DMatrix("ext:big.svm#cache")``) or from an iterator of
+    ``(X_dense, y)`` chunks.  Raw CSR pages are spilled to
+    ``<cache>.pages``; after binning, a ``<cache>.binned`` memmap holds
+    the quantized matrix.
+    """
+
+    is_external = True
+
+    def __init__(self, data, label=None, weight=None,
+                 cache: Optional[str] = None,
+                 page_rows: int = DEFAULT_PAGE_ROWS, missing: float = np.nan,
+                 silent: bool = True):
+        self.info = MetaInfo()
+        self.page_rows = page_rows
+        self._binned_path: Optional[str] = None
+        self._binned_mm: Optional[np.memmap] = None
+        self._binned_cuts: Optional[CutMatrix] = None
+        self._binned_dtype = np.uint8
+        self.feature_names = None
+        self._col_cache = None
+
+        if isinstance(data, str):
+            path, _, cachesuffix = data.partition("#")
+            if cache is None:
+                cache = cachesuffix or path + ".extcache"
+            self.cache_prefix = cache
+            self._ingest_libsvm(path, missing, silent)
+            load_meta_sidecars(self, path)
+        else:
+            if cache is None:
+                cache = os.path.join(
+                    tempfile.mkdtemp(prefix="xgbtpu_ext_"), "m")
+            self.cache_prefix = cache
+            self._ingest_chunks(iter(data), missing)
+        if label is not None:
+            self.info.set_field("label", label)
+        if weight is not None:
+            self.info.set_field("weight", weight)
+
+    # ------------------------------------------------------------- ingest
+    def _pages_path(self) -> str:
+        return self.cache_prefix + ".pages"
+
+    def _ingest_libsvm(self, path: str, missing: float, silent: bool):
+        from xgboost_tpu.data import parse_libsvm
+        # the parser is the native multithreaded one when available; rows
+        # then stream out to the page store so later passes are paged
+        indptr, indices, values, labels = parse_libsvm(path)
+        self._num_col = int(indices.max()) + 1 if len(indices) else 0
+        self.info.set_field("label", labels)
+        self._write_pages_from_csr(indptr, indices, values)
+        self._num_row = len(labels)
+
+    def _ingest_chunks(self, chunks: Iterator[Tuple[np.ndarray, np.ndarray]],
+                       missing: float):
+        labels: List[np.ndarray] = []
+        writer = self._page_writer()
+        n_rows = 0
+        num_col = 0
+        for X, y in chunks:
+            X = np.asarray(X, np.float32)
+            num_col = max(num_col, X.shape[1])
+            present = ~np.isnan(X) if np.isnan(missing) else X != missing
+            counts = present.sum(axis=1)
+            indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+            rows, cols = np.nonzero(present)
+            self._push_page(writer, indptr, cols.astype(np.int32),
+                            X[rows, cols].astype(np.float32))
+            labels.append(np.asarray(y, np.float32))
+            n_rows += X.shape[0]
+        self._close_writer(writer)
+        self._num_row = n_rows
+        self._num_col = num_col
+        if labels:
+            self.info.set_field("label", np.concatenate(labels))
+
+    def _write_pages_from_csr(self, indptr, indices, values):
+        writer = self._page_writer()
+        n = len(indptr) - 1
+        for start in range(0, n, self.page_rows):
+            stop = min(start + self.page_rows, n)
+            self._push_page(writer, indptr[start:stop + 1],
+                            indices, values)
+        self._close_writer(writer)
+
+    # page-store backends: native lib, or an in-RAM list fallback
+    def _page_writer(self):
+        from xgboost_tpu import native
+        if native.available():
+            return native.PageWriter(self._pages_path())
+        self._ram_pages: List[tuple] = []
+        return None
+
+    def _push_page(self, writer, indptr, indices, values):
+        if writer is not None:
+            writer.push(indptr, indices, values)
+        else:
+            base = indptr[0]
+            self._ram_pages.append(
+                (np.asarray(indptr) - base,
+                 np.asarray(indices[base:indptr[-1]], np.int32),
+                 np.asarray(values[base:indptr[-1]], np.float32)))
+
+    def _close_writer(self, writer):
+        if writer is not None:
+            writer.close()
+
+    def iter_raw_pages(self):
+        """Yield (indptr, indices, values) CSR pages."""
+        from xgboost_tpu import native
+        if native.available() and os.path.exists(self._pages_path()):
+            with native.PageReader(self._pages_path()) as r:
+                for page in r:
+                    yield page
+        else:
+            yield from self._ram_pages
+
+    # ---------------------------------------------------- DMatrix protocol
+    @property
+    def num_row(self) -> int:
+        return self._num_row
+
+    @property
+    def num_col(self) -> int:
+        return self._num_col
+
+    def get_label(self):
+        return self.info.label
+
+    def get_weight(self):
+        return self.info.get_weight(self.num_row)
+
+    def get_base_margin(self):
+        return self.info.base_margin
+
+    def set_label(self, label):
+        self.info.set_field("label", label)
+
+    def set_weight(self, weight):
+        self.info.set_field("weight", weight)
+
+    def set_group(self, group):
+        self.info.set_field("group", group)
+
+    def set_base_margin(self, margin):
+        self.info.set_field("base_margin", margin)
+
+    def slice(self, rindex):
+        raise NotImplementedError(
+            "slice() is not supported on external-memory matrices")
+
+    # ------------------------------------------------------------- sketch
+    def sketch_cuts(self, max_bin: int = 256, sketch_eps: float = 0.03,
+                    sketch_ratio: float = 2.0) -> CutMatrix:
+        """Streaming per-feature quantile sketch over raw pages (the
+        reference's per-batch sketch push, basemaker-inl.hpp:307-385)."""
+        F = self.num_col
+        maxsize = max(2, int(sketch_ratio / max(sketch_eps, 1.0 / max_bin)))
+        summaries: List[QuantileSummary] = [empty_summary() for _ in range(F)]
+        for indptr, indices, values in self.iter_raw_pages():
+            order = np.argsort(indices, kind="stable")
+            sorted_cols = indices[order]
+            starts = np.searchsorted(sorted_cols, np.arange(F + 1))
+            for f in range(F):
+                sel = order[starts[f]:starts[f + 1]]
+                if len(sel) == 0:
+                    continue
+                s = prune_summary(make_summary(values[sel]), maxsize)
+                summaries[f] = prune_summary(
+                    merge_summaries(summaries[f], s), maxsize)
+        from xgboost_tpu.binning import pack_cuts
+        return pack_cuts([propose_cuts(s, max_bin - 1) for s in summaries])
+
+    # ------------------------------------------------------------ binning
+    def build_binned(self, cuts: CutMatrix) -> None:
+        """Quantize raw pages into the on-disk binned memmap.
+
+        Width is the MODEL's feature count (like the in-RAM bin_matrix):
+        a matrix whose max observed feature index is below the model's
+        num_feature still gets columns for every model feature, so tree
+        traversal never gathers out of bounds."""
+        width = max(self.num_col, cuts.num_feature)
+        self._binned_dtype = np.uint8 if cuts.max_bin <= 256 else np.uint16
+        self._binned_path = self.cache_prefix + ".binned"
+        mm = np.memmap(self._binned_path, dtype=self._binned_dtype,
+                       mode="w+", shape=(self.num_row, width))
+        row0 = 0
+        for indptr, indices, values in self.iter_raw_pages():
+            n = len(indptr) - 1
+            page = np.zeros((n, width), dtype=self._binned_dtype)
+            rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+            for f in range(min(self.num_col, cuts.num_feature)):
+                m = indices == f
+                if not m.any():
+                    continue
+                b = 1 + np.searchsorted(
+                    cuts.cut_values[f, :cuts.n_cuts[f]], values[m],
+                    side="right")
+                page[rows[m], f] = b.astype(self._binned_dtype)
+            mm[row0:row0 + n] = page
+            row0 += n
+        mm.flush()
+        self._binned_mm = np.memmap(self._binned_path,
+                                    dtype=self._binned_dtype, mode="r",
+                                    shape=(self.num_row, width))
+        self._binned_cuts = cuts  # identity-tracked: see Booster._entry
+
+    def binned_batches(self, batch_rows: Optional[int] = None):
+        """Yield (row_start, binned_np) batches of the quantized matrix."""
+        assert self._binned_mm is not None, "call build_binned first"
+        step = batch_rows or self.page_rows
+        for start in range(0, self.num_row, step):
+            yield start, np.asarray(self._binned_mm[start:start + step])
+
+
+# ------------------------------------------------------------- paged grow
+@functools.partial(jax.jit, static_argnames=("depth", "n_bin"))
+def _paged_level_hist(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
+                      depth: int, n_bin: int):
+    """Partial histogram + node stats for one batch at one level: row
+    positions are recomputed by traversing the partial tree."""
+    node = jnp.zeros_like(binned[:, 0], dtype=jnp.int32)
+    alive = jnp.ones(binned.shape[0], jnp.bool_)
+    for _ in range(depth):
+        f = tree.feature[node]
+        at_leaf = tree.is_leaf[node] | (f < 0)
+        b = jnp.take_along_axis(binned.astype(jnp.int32),
+                                jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        go_left = jnp.where(b == 0, tree.default_left[node],
+                            b <= tree.cut_index[node] + 1)
+        nxt = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
+        alive = alive & ~at_leaf
+        node = jnp.where(at_leaf, node, nxt)
+    n_node = 1 << depth
+    pos = jnp.where(alive, node - (n_node - 1), -1)
+    hist = build_level_histogram(binned, gh, pos, n_node, n_bin)
+    return hist, node_stats(gh, pos, n_node)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def _paged_leaf_delta(tree: TreeArrays, binned: jax.Array, max_depth: int):
+    return tree.leaf_value[_traverse_one(tree, binned, max_depth)]
+
+
+def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
+                    cut_values: jax.Array, n_cuts: jax.Array,
+                    cfg: GrowConfig) -> TreeArrays:
+    """Level-by-level growth streaming binned batches host→device.
+
+    gh: (N, 2) host gradients.  Row subsampling uses a host-side
+    deterministic draw.  Returns the grown tree (delta is computed by the
+    caller via :func:`_paged_leaf_delta` batch by batch).
+    """
+    from xgboost_tpu.models.tree import (_default_split_finder,
+                                         _sample_features)
+
+    key_rows, key_ftree, key_flevel = jax.random.split(key, 3)
+    gh_used = gh
+    if cfg.subsample < 1.0:
+        keep = np.asarray(
+            jax.random.uniform(key_rows, (dmat.num_row,))) < cfg.subsample
+        gh_used = gh * keep[:, None].astype(np.float32)
+
+    F = int(n_cuts.shape[0])
+    fmask_tree = _sample_features(key_ftree, F, cfg.colsample_bytree)
+
+    tree = empty_tree(cfg.max_depth)
+    for depth in range(cfg.max_depth + 1):
+        n_node = 1 << depth
+        hist = None
+        nst = None
+        for start, batch in dmat.binned_batches():
+            bgh = jnp.asarray(gh_used[start:start + batch.shape[0]])
+            h, s = _paged_level_hist(tree, jnp.asarray(batch), bgh, depth,
+                                     cfg.n_bin)
+            hist = h if hist is None else hist + h
+            nst = s if nst is None else nst + s
+        if depth == cfg.max_depth:
+            make_leaf = jnp.ones(n_node, jnp.bool_)
+            best = None
+        else:
+            fmask = fmask_tree
+            if cfg.colsample_bylevel < 1.0:
+                fmask = fmask & _sample_features(
+                    jax.random.fold_in(key_flevel, depth), F,
+                    cfg.colsample_bylevel)
+            best = _default_split_finder(hist, nst, n_cuts, cut_values,
+                                         fmask, cfg.split)
+            can_try = nst[:, 1] >= 2.0 * cfg.split.min_child_weight
+            make_leaf = ~(best.valid & can_try)
+        tree = apply_level(tree, depth, nst, best, make_leaf, cfg.split)
+    return tree

@@ -36,7 +36,7 @@ class _CacheEntry:
 
     def __init__(self, dmat: DMatrix, binned: jax.Array, base_margin: jax.Array,
                  info=None, row_valid: Optional[jax.Array] = None,
-                 n_real: Optional[int] = None):
+                 n_real: Optional[int] = None, external: bool = False):
         self.dmat = dmat                 # strong ref: id(dmat) keys the cache
         self.binned = binned
         self.base = base_margin          # (N_pad, K)
@@ -45,6 +45,7 @@ class _CacheEntry:
         self.n_real = n_real if n_real is not None else dmat.num_row
         self.margin: Optional[jax.Array] = None
         self.applied = 0                 # trees folded into margin
+        self.external = external         # paged matrix: margin lives on host
 
 
 class Booster:
@@ -106,7 +107,14 @@ class Booster:
                 from xgboost_tpu.models.gbtree import GBTree
                 from xgboost_tpu.models.updaters import parse_updaters
                 self.num_feature = dtrain.num_col
-                if "grow_colmaker" in parse_updaters(self.param.updater):
+                if getattr(dtrain, "is_external", False):
+                    # streaming sketch over raw pages (SURVEY.md §5.7);
+                    # paged matrices always use the histogram method, as
+                    # in the reference (learner-inl.hpp:263-267)
+                    cuts = dtrain.sketch_cuts(self.param.max_bin,
+                                              self.param.sketch_eps,
+                                              self.param.sketch_ratio)
+                elif "grow_colmaker" in parse_updaters(self.param.updater):
                     # exact greedy: cuts at every distinct value (under
                     # dsplit=col this is the distributed exact mode — the
                     # reference's DistColMaker extends ColMaker)
@@ -145,12 +153,19 @@ class Booster:
 
     def _entry(self, dmat: DMatrix) -> _CacheEntry:
         key = id(dmat)
+        if (key in self._cache and self._cache[key].external
+                and dmat._binned_cuts is not self.gbtree.cuts):
+            # another model re-quantized this matrix meanwhile: re-bin and
+            # rebuild our margins from scratch
+            self._cache[key] = self._build_ext_entry(dmat)
         if key not in self._cache:
             if self.num_feature and dmat.num_col > self.num_feature:
                 raise ValueError(
                     f"data has {dmat.num_col} features, model was trained "
                     f"with {self.num_feature}")
-            if self.param.booster == "gblinear":
+            if getattr(dmat, "is_external", False):
+                self._cache[key] = self._build_ext_entry(dmat)
+            elif self.param.booster == "gblinear":
                 binned = self.gbtree.device_matrix(dmat)
                 self._cache[key] = _CacheEntry(
                     dmat, binned, self._base_margin_of(dmat, dmat.num_row))
@@ -167,6 +182,21 @@ class Booster:
                 self._cache[key] = _CacheEntry(
                     dmat, binned, self._base_margin_of(dmat, dmat.num_row))
         return self._cache[key]
+
+    def _build_ext_entry(self, dmat) -> _CacheEntry:
+        """Entry for an external-memory matrix (not necessarily cached)."""
+        if self._mesh is not None or self._col_mesh is not None:
+            raise NotImplementedError(
+                "external-memory matrices are single-chip for now "
+                "(dsplit=row/col unsupported)")
+        # (re)quantize when the matrix was binned with a DIFFERENT
+        # model's cuts — reusing a stale memmap would silently compare
+        # this model's cut indices against another model's bins
+        if dmat._binned_mm is None or dmat._binned_cuts is not self.gbtree.cuts:
+            dmat.build_binned(self.gbtree.cuts)
+        return _CacheEntry(
+            dmat, None, np.asarray(self._base_margin_of(dmat, dmat.num_row)),
+            external=True)
 
     def _make_sharded_entry(self, dmat: DMatrix) -> _CacheEntry:
         """Pad rows to the mesh size and shard over the 'data' axis (the
@@ -193,6 +223,9 @@ class Booster:
     def _sync_margin(self, entry: _CacheEntry):
         """Fold not-yet-applied trees into the cached margin, one round's
         worth at a time (fixed shapes -> one compilation)."""
+        if entry.external:
+            self._sync_margin_ext(entry)
+            return
         if entry.margin is None:
             entry.margin = jnp.broadcast_to(
                 entry.base, (entry.binned.shape[0], self._K)).astype(jnp.float32)
@@ -208,6 +241,28 @@ class Booster:
                 entry.binned, entry.margin, chunk, first_group)
             entry.applied += len(chunk)
 
+    def _sync_margin_ext(self, entry: _CacheEntry):
+        """Host-side margin for an external-memory matrix, rebuilt by
+        streaming binned batches through the not-yet-applied trees."""
+        if entry.margin is None:
+            entry.margin = np.broadcast_to(
+                entry.base, (entry.n_real, self._K)).astype(np.float32).copy()
+            entry.applied = 0
+        if entry.applied >= self.gbtree.num_trees:
+            return
+        import jax.numpy as _jnp
+        from xgboost_tpu.models.tree import predict_margin_binned
+        chunk_trees = self.gbtree.trees[entry.applied:]
+        groups = self.gbtree.tree_group[entry.applied:]
+        stack = jax.tree.map(lambda *xs: _jnp.stack(xs), *chunk_trees)
+        group = _jnp.asarray(groups, _jnp.int32)
+        for start, batch in entry.dmat.binned_batches():
+            m = predict_margin_binned(
+                stack, group, _jnp.asarray(batch), _jnp.zeros((), _jnp.float32),
+                self.gbtree.cfg.max_depth, self._K)
+            entry.margin[start:start + batch.shape[0]] += np.asarray(m)
+        entry.applied = self.gbtree.num_trees
+
     # ------------------------------------------------------------- training
     def update(self, dtrain: DMatrix, iteration: int, fobj=None):
         """One boosting round (reference BoostLearner::UpdateOneIter,
@@ -217,8 +272,8 @@ class Booster:
         entry = self._entry(dtrain)
         self._sync_margin(entry)
         if fobj is None:
-            gh = self.obj.get_gradient(entry.margin, entry.info, iteration,
-                                       entry.binned.shape[0])
+            gh = self.obj.get_gradient(jnp.asarray(entry.margin), entry.info,
+                                       iteration, entry.margin.shape[0])
         else:
             # custom objective sees only the real rows; gradients are
             # zero-padded back to the device row count below in boost()
@@ -238,7 +293,9 @@ class Booster:
         self._sync_margin(entry)
         g = np.asarray(grad, np.float32).reshape(dtrain.num_row, self._K)
         h = np.asarray(hess, np.float32).reshape(dtrain.num_row, self._K)
-        pad = entry.binned.shape[0] - dtrain.num_row
+        n_dev = (entry.binned.shape[0] if entry.binned is not None
+                 else entry.margin.shape[0])  # external: margin is host-side
+        pad = n_dev - dtrain.num_row
         if pad:  # zero-gradient padding rows (dsplit=row sharding)
             g = np.concatenate([g, np.zeros((pad, self._K), np.float32)])
             h = np.concatenate([h, np.zeros((pad, self._K), np.float32)])
@@ -261,6 +318,16 @@ class Booster:
             return
         from xgboost_tpu.models.updaters import parse_updaters
         ups = parse_updaters(self.param.updater)
+        if entry.external:
+            if "refresh" in ups:
+                raise NotImplementedError(
+                    "updater=refresh is not supported on external-memory "
+                    "matrices")
+            deltas = self.gbtree.do_boost_paged(entry.dmat, np.asarray(gh),
+                                                key)
+            entry.margin += deltas
+            entry.applied = self.gbtree.num_trees
+            return
         grows = any(u.startswith("grow") or u == "distcol" for u in ups)
         if grows:
             _, delta = self.gbtree.do_boost(entry.binned, gh, key,
@@ -296,6 +363,32 @@ class Booster:
         Booster.predict, wrapper/xgboost.py:422-450)."""
         assert self.gbtree is not None, "model not trained/loaded"
         cached = self._cache.get(id(data))
+        if cached is None and getattr(data, "is_external", False):
+            # one-off external prediction: build a transient entry WITHOUT
+            # registering it (the buffer_offset=-1 path — registering every
+            # served matrix would grow the cache unboundedly)
+            cached = self._build_ext_entry(data)
+        if cached is not None and cached.external:
+            if pred_leaf:
+                leaves = [np.asarray(self.gbtree.predict_leaf(
+                    jnp.asarray(batch), ntree_limit))
+                    for _, batch in data.binned_batches()]
+                return np.concatenate(leaves, axis=0)
+            if ntree_limit == 0:
+                self._sync_margin(cached)
+                margin = cached.margin
+            else:
+                margin = np.concatenate(
+                    [np.asarray(self.gbtree.predict_margin(
+                        jnp.asarray(batch),
+                        np.asarray(cached.base)[s:s + batch.shape[0]],
+                        ntree_limit))
+                     for s, batch in data.binned_batches()], axis=0)
+            out = np.asarray(self.obj.pred_transform(
+                jnp.asarray(margin), output_margin=output_margin))
+            if out.ndim == 2 and out.shape[1] == 1:
+                out = out[:, 0]
+            return out
         if cached is None:
             # one-off prediction: no cache registration (the reference's
             # buffer_offset = -1 path, learner-inl.hpp:332-346)

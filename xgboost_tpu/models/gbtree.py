@@ -137,6 +137,36 @@ class GBTree:
         self._stack_cache = None
         return new_trees, jnp.stack(deltas, axis=1)
 
+    # ----------------------------------------------------------- paged boost
+    def do_boost_paged(self, dmat, gh: np.ndarray, key: jax.Array) -> np.ndarray:
+        """One boosting round over an external-memory matrix: histograms
+        accumulate batch-by-batch (SURVEY.md §5.7), gradients/margins stay
+        host-side.  gh: (N, K, 2) numpy.  Returns the (N, K) margin delta."""
+        from xgboost_tpu.external import _paged_leaf_delta, grow_tree_paged
+        from xgboost_tpu.models.updaters import parse_updaters, prune_tree
+
+        do_prune = ("prune" in parse_updaters(self.param.updater)
+                    and self.param.gamma > 0.0)
+        K = max(1, self.param.num_output_group)
+        npar = max(1, self.param.num_parallel_tree)
+        deltas = np.zeros((dmat.num_row, K), np.float32)
+        for k in range(K):
+            for t in range(npar):
+                tkey = jax.random.fold_in(key, k * npar + t)
+                tree = grow_tree_paged(tkey, dmat, gh[:, k, :],
+                                       self.cut_values_dev, self.n_cuts_dev,
+                                       self.cfg)
+                if do_prune:
+                    tree, _ = prune_tree(tree, self.param.gamma)
+                for start, batch in dmat.binned_batches():
+                    d = _paged_leaf_delta(tree, jnp.asarray(batch),
+                                          self.cfg.max_depth)
+                    deltas[start:start + batch.shape[0], k] += np.asarray(d)
+                self.trees.append(tree)
+                self.tree_group.append(k)
+        self._stack_cache = None
+        return deltas
+
     # --------------------------------------------------------------- refresh
     def do_refresh(self, binned: jax.Array, gh: jax.Array,
                    row_valid: Optional[jax.Array] = None, mesh=None) -> None:

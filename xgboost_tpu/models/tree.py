@@ -144,16 +144,7 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
     # guaranteed non-empty fallback.
     feat_mask_tree = feat_sampler(key_ftree, cfg.colsample_bytree, binned)
 
-    tree = TreeArrays(
-        feature=jnp.full(n_total, -1, jnp.int32),
-        cut_index=jnp.zeros(n_total, jnp.int32),
-        threshold=jnp.zeros(n_total, jnp.float32),
-        default_left=jnp.zeros(n_total, jnp.bool_),
-        is_leaf=jnp.zeros(n_total, jnp.bool_),
-        leaf_value=jnp.zeros(n_total, jnp.float32),
-        gain=jnp.zeros(n_total, jnp.float32),
-        sum_hess=jnp.zeros(n_total, jnp.float32),
-    )
+    tree = empty_tree(D)
 
     pos = jnp.zeros(N, jnp.int32)  # level-local position; -1 = parked in a leaf
     if row_valid is not None:
@@ -184,33 +175,7 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
             do_split = best.valid & can_try
             make_leaf = ~do_split
 
-        # node occupancy: a level node is "live" iff some ancestor path made
-        # it; detect via sum_hess>0 OR it is the root.  Empty nodes get
-        # is_leaf=False and are unreachable, which is fine.
-        live = (nst[:, 1] > 0.0) | (jnp.arange(n_node) == 0) if depth == 0 \
-            else (nst[:, 1] > 0.0)
-
-        # the would-be leaf weight is recorded for EVERY live node (not just
-        # leaves): the prune updater turns split nodes back into leaves and
-        # needs their weight (reference keeps base_weight in RTreeNodeStat)
-        leaf_w = calc_weight(nst[:, 0], nst[:, 1], cfg.split) * cfg.split.eta
-        idx = base + jnp.arange(n_node)
-        tree = tree._replace(
-            sum_hess=tree.sum_hess.at[idx].set(nst[:, 1]),
-            is_leaf=tree.is_leaf.at[idx].set(make_leaf & live),
-            leaf_value=tree.leaf_value.at[idx].set(leaf_w),
-        )
-        if best is not None:
-            keep_split = ~make_leaf
-            tree = tree._replace(
-                feature=tree.feature.at[idx].set(
-                    jnp.where(keep_split, best.feature, -1)),
-                cut_index=tree.cut_index.at[idx].set(best.cut_index),
-                threshold=tree.threshold.at[idx].set(best.threshold),
-                default_left=tree.default_left.at[idx].set(best.default_left),
-                gain=tree.gain.at[idx].set(
-                    jnp.where(keep_split, best.gain, 0.0)),
-            )
+        tree = apply_level(tree, depth, nst, best, make_leaf, cfg.split)
 
         # park rows whose node became a leaf; route the rest to children
         active = pos >= 0
@@ -223,6 +188,58 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
             pos = jnp.where(active & ~row_is_leaf, new_pos, -1)
 
     return tree, row_leaf
+
+
+def apply_level(tree: TreeArrays, depth: int, nst: jax.Array,
+                best: Optional[SplitDecision], make_leaf: jax.Array,
+                split_cfg) -> TreeArrays:
+    """Write one level's decisions into the tree arrays (shared by the
+    in-memory, distributed and paged growers)."""
+    n_node = 1 << depth
+    base = n_node - 1
+    # node occupancy: a level node is "live" iff some ancestor path made
+    # it; detect via sum_hess>0 OR it is the root.  Empty nodes get
+    # is_leaf=False and are unreachable, which is fine.
+    live = (nst[:, 1] > 0.0) | (jnp.arange(n_node) == 0) if depth == 0 \
+        else (nst[:, 1] > 0.0)
+
+    # the would-be leaf weight is recorded for EVERY live node (not just
+    # leaves): the prune updater turns split nodes back into leaves and
+    # needs their weight (reference keeps base_weight in RTreeNodeStat)
+    leaf_w = calc_weight(nst[:, 0], nst[:, 1], split_cfg) * split_cfg.eta
+    idx = base + jnp.arange(n_node)
+    tree = tree._replace(
+        sum_hess=tree.sum_hess.at[idx].set(nst[:, 1]),
+        is_leaf=tree.is_leaf.at[idx].set(make_leaf & live),
+        leaf_value=tree.leaf_value.at[idx].set(leaf_w),
+    )
+    if best is not None:
+        keep_split = ~make_leaf
+        tree = tree._replace(
+            feature=tree.feature.at[idx].set(
+                jnp.where(keep_split, best.feature, -1)),
+            cut_index=tree.cut_index.at[idx].set(best.cut_index),
+            threshold=tree.threshold.at[idx].set(best.threshold),
+            default_left=tree.default_left.at[idx].set(best.default_left),
+            gain=tree.gain.at[idx].set(
+                jnp.where(keep_split, best.gain, 0.0)),
+        )
+    return tree
+
+
+def empty_tree(max_depth: int) -> TreeArrays:
+    """All-unused tree arrays for a depth-``max_depth`` perfect layout."""
+    n_total = tree_capacity(max_depth)
+    return TreeArrays(
+        feature=jnp.full(n_total, -1, jnp.int32),
+        cut_index=jnp.zeros(n_total, jnp.int32),
+        threshold=jnp.zeros(n_total, jnp.float32),
+        default_left=jnp.zeros(n_total, jnp.bool_),
+        is_leaf=jnp.zeros(n_total, jnp.bool_),
+        leaf_value=jnp.zeros(n_total, jnp.float32),
+        gain=jnp.zeros(n_total, jnp.float32),
+        sum_hess=jnp.zeros(n_total, jnp.float32),
+    )
 
 
 def _sample_features(key: jax.Array, F: int, rate: float) -> jax.Array:
