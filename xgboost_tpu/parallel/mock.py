@@ -1,0 +1,75 @@
+"""Fault injection for the collective/training seam.
+
+The reference's ``AllreduceMock`` kills a worker at an exact
+``(rank, version, seqno, ntrial)`` collective call
+(``subtree/rabit/src/allreduce_mock.h:37-44,166-172``); a keepalive
+wrapper restarts it and recovery must reproduce bit-identical state
+(``tracker/rabit_demo.py:26-40``, ``test/local_recover.cc:30-60``).
+
+Under XLA, collectives inside a jitted step are not interruptible
+mid-step, so the injection points are the host-side entries into
+collective work: one "seqno" per tree-growth launch within a boosting
+round ("version").  ``ntrial`` counts process restarts, so an injection
+fires once and the restarted run sails past it — exactly the reference's
+mock semantics.
+
+Deterministic recovery holds because per-iteration seeding is derived by
+``fold_in(seed, iteration)`` (the reference forces seed_per_iteration in
+distributed mode for the same reason, learner-inl.hpp:275-277).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+class WorkerFailure(RuntimeError):
+    """Simulated worker death (reference mock's exit(-2))."""
+
+
+class FaultInjector:
+    """Dies when a registered (version, seqno, ntrial) coordinate is hit."""
+
+    def __init__(self, spec: List[Tuple[int, int, int]], trial: int = 0):
+        self.spec = set(spec)
+        self.trial = trial
+        self.version = -1
+        self.seqno = 0
+
+    def begin_round(self, version: int) -> None:
+        self.version = version
+        self.seqno = 0
+
+    def collective(self) -> None:
+        coord = (self.version, self.seqno, self.trial)
+        self.seqno += 1
+        if (self.version, coord[1], self.trial) in self.spec:
+            raise WorkerFailure(
+                f"[mock] die at version={coord[0]} seqno={coord[1]} "
+                f"trial={self.trial}")
+
+
+_injector: Optional[FaultInjector] = None
+
+
+def set_fault_injection(spec: List[Tuple[int, int, int]],
+                        trial: int = 0) -> None:
+    """Install a process-wide injector (reference mock= parameter)."""
+    global _injector
+    _injector = FaultInjector(spec, trial)
+
+
+def clear_fault_injection() -> None:
+    global _injector
+    _injector = None
+
+
+def begin_round(version: int) -> None:
+    if _injector is not None:
+        _injector.begin_round(version)
+
+
+def collective() -> None:
+    """Call at every host-side collective entry (tree-growth launch)."""
+    if _injector is not None:
+        _injector.collective()
