@@ -72,6 +72,45 @@ def test_dp_multiclass(mesh8):
     assert res["train-merror"][-1] < 0.2
 
 
+def test_dp_multiclass_base_margin_odd_rows(mesh8):
+    """K>1 + base_margin + dsplit=row with padding: the raveled (n*K,)
+    margin must pad per-row, and the model must match single-device."""
+    rng = np.random.RandomState(5)
+    n = 2043  # not divisible by 8
+    X = rng.randn(n, 6).astype(np.float32)
+    y = np.argmax(X[:, :3] + 0.2 * rng.randn(n, 3), axis=1).astype(np.float32)
+    margin = rng.randn(n, 3).astype(np.float32) * 0.1
+    params = {"objective": "multi:softprob", "num_class": 3, "max_depth": 3,
+              "eta": 0.5}
+
+    d_dp = xgb.DMatrix(X, label=y)
+    d_dp.set_base_margin(margin.ravel())
+    bst_dp = xgb.train({**params, "dsplit": "row"}, d_dp, 3,
+                       verbose_eval=False)
+    p_dp = bst_dp.predict(d_dp)
+    assert p_dp.shape == (n, 3)
+
+    d1 = xgb.DMatrix(X, label=y)
+    d1.set_base_margin(margin.ravel())
+    p1 = xgb.train(params, d1, 3, verbose_eval=False).predict(d1)
+    np.testing.assert_allclose(p1, p_dp, rtol=2e-4, atol=2e-5)
+
+
+def test_dp_padding_margin_invariant(mesh8):
+    """Cached margins of padding rows must stay at base across rounds
+    (they feed get_gradient; garbage would leak via prune's node-0 value)."""
+    X, y = make_data(n=4091)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                     "eta": 0.5, "gamma": 0.2, "dsplit": "row"}, d, 3,
+                    verbose_eval=False)
+    entry = bst._cache[id(d)]
+    margin = np.asarray(entry.margin).reshape(-1)
+    valid = np.asarray(entry.row_valid).reshape(-1)
+    base = np.asarray(entry.base).reshape(-1)
+    np.testing.assert_allclose(margin[~valid], base[~valid], atol=1e-6)
+
+
 def test_dp_deterministic(mesh8):
     X, y = make_data(n=2048)
     params = {"objective": "binary:logistic", "max_depth": 4,

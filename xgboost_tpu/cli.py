@@ -195,15 +195,15 @@ class BoostLearnTask:
                 self._save(bst, i)
             if self.checkpoint_dir:
                 _save_checkpoint(self.checkpoint_dir, bst, i + 1)
-        # always save final round (reference xgboost_main.cpp:218-224)
+        # save final round unless a periodic numbered save already covered
+        # it (reference xgboost_main.cpp:219-225: no final save when
+        # save_period divides num_round, even with model_out set)
         if self.save_final and (self.save_period == 0
                                 or self.num_round % self.save_period != 0):
             if self.model_out is not None:
                 self._save(bst)
             else:
                 self._save(bst, self.num_round - 1)
-        elif self.save_final and self.model_out is not None:
-            self._save(bst)
         if not self.silent:
             print(f"\nupdating end, {time.time() - start:.0f} sec in all",
                   file=sys.stderr)

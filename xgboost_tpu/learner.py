@@ -211,7 +211,7 @@ class Booster:
         binned = shard_rows(self._mesh, jnp.asarray(binned_np))
         row_valid = shard_rows(self._mesh, jnp.asarray(
             np.arange(n + pad) < n))
-        info = _pad_info(dmat.info, n, pad)
+        info = _pad_info(dmat.info, n, pad, self._K)
         base = np.broadcast_to(
             np.asarray(self._base_margin_of(dmat, n)), (n, self._K))
         base = np.concatenate(
@@ -521,7 +521,7 @@ class Booster:
         return feature_importance(self, fmap)
 
 
-def _pad_info(info: MetaInfo, n: int, pad: int) -> MetaInfo:
+def _pad_info(info: MetaInfo, n: int, pad: int, k: int = 1) -> MetaInfo:
     """Row-pad metadata with zero-weight rows so padded rows produce zero
     gradients (group_ptr is left untouched: rows past gptr[-1] are
     group-less and get no ranking pairs)."""
@@ -534,8 +534,11 @@ def _pad_info(info: MetaInfo, n: int, pad: int) -> MetaInfo:
     out.weight = np.concatenate(
         [info.get_weight(n), np.zeros(pad, np.float32)])
     if info.base_margin is not None:
+        # base_margin may arrive flat (n,), raveled (n*k,) or (n, k):
+        # pad along ROWS so a later reshape(n_pad, k) stays valid
+        bm = np.asarray(info.base_margin, np.float32).reshape(n, k)
         out.base_margin = np.concatenate(
-            [info.base_margin, np.zeros(pad, np.float32)])
+            [bm, np.zeros((pad, k), np.float32)])
     if info.group_ptr is None:
         # one explicit group over the real rows, so ranking objectives never
         # pair padding rows

@@ -129,6 +129,11 @@ class GBTree:
                     d = tree.leaf_value[jnp.asarray(resolve)[row_leaf]]
                 elif d is None:
                     d = tree.leaf_value[row_leaf]
+                if row_valid is not None:
+                    # padding rows land on node 0, which carries the root's
+                    # would-be leaf weight; zero their delta so their cached
+                    # margin stays at the entry's (zero-padded) base value
+                    d = d * row_valid.astype(d.dtype)
                 new_trees.append(tree)
                 self.trees.append(tree)
                 self.tree_group.append(k)

@@ -37,6 +37,12 @@ f32p = ctypes.POINTER(ctypes.c_float)
 
 
 def _build() -> bool:
+    if os.environ.get("XGTPU_NO_NATIVE_BUILD"):
+        return False
+    import sys
+    print("xgboost_tpu: building native IO library (first use; set "
+          "XGTPU_NO_NATIVE_BUILD=1 to skip and use the Python parser)",
+          file=sys.stderr)
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR, "lib"], check=True,
                        capture_output=True, timeout=120)
