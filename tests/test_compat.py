@@ -1,0 +1,105 @@
+"""Reference binary model format: reader + cross-check fixtures.
+
+The committed fixtures in ``tests/data/`` were produced by the reference
+CLI built from ``/root/reference`` (demo/binary_classification
+mushroom.conf, 2 rounds, depth 3):
+
+- ``ref_agaricus.model``  — ``binf`` binary model (with pred buffer)
+- ``ref_agaricus.bs64``   — the same model in base64 text mode
+  (``model_out=stdout``)
+- ``ref_agaricus.pred``   — the reference CLI's own predictions on
+  agaricus.txt.test (``%g`` precision)
+
+so the round-trip bar is: load the reference's bytes, predict, match the
+reference's numbers (SURVEY.md M2).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.compat import load_reference_model, parse_reference_model
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+AGARICUS_TEST = "/root/reference/demo/data/agaricus.txt.test"
+AGARICUS_TRAIN = "/root/reference/demo/data/agaricus.txt.train"
+
+
+@pytest.fixture(scope="module")
+def ref_model_path():
+    return os.path.join(DATA, "ref_agaricus.model")
+
+
+def test_parse_reference_model(ref_model_path):
+    with open(ref_model_path, "rb") as f:
+        parsed = parse_reference_model(f.read())
+    assert parsed["objective"] == "binary:logistic"
+    assert parsed["gbm"] == "gbtree"
+    assert parsed["num_feature"] == 126
+    assert len(parsed["trees"]) == 2
+    assert list(parsed["tree_info"]) == [0, 0]
+    nodes, stats = parsed["trees"][0]
+    assert (nodes["cleft"] == -1).sum() > 0          # has leaves
+    assert (stats["sum_hess"] > 0).all()
+
+
+def test_reference_model_predictions_match(ref_model_path):
+    """Predictions from the loaded reference model must equal the
+    reference CLI's own pred output."""
+    bst = load_reference_model(ref_model_path)
+    dtest = xgb.DMatrix(AGARICUS_TEST, num_col=126)
+    preds = bst.predict(dtest)
+    ref = np.loadtxt(os.path.join(DATA, "ref_agaricus.pred"))
+    assert preds.shape == ref.shape
+    np.testing.assert_allclose(preds, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_reference_bs64_matches_binf():
+    b1 = load_reference_model(os.path.join(DATA, "ref_agaricus.model"))
+    b2 = load_reference_model(os.path.join(DATA, "ref_agaricus.bs64"))
+    dtest = xgb.DMatrix(AGARICUS_TEST, num_col=126)
+    np.testing.assert_array_equal(b1.predict(dtest), b2.predict(dtest))
+
+
+def test_booster_load_model_autodetects_reference(ref_model_path):
+    """Booster(model_file=...) must transparently read reference files."""
+    bst = xgb.Booster(model_file=ref_model_path)
+    dtest = xgb.DMatrix(AGARICUS_TEST, num_col=126)
+    ref = np.loadtxt(os.path.join(DATA, "ref_agaricus.pred"))
+    np.testing.assert_allclose(bst.predict(dtest), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_save_base64_roundtrip(tmp_path):
+    """Our own bs64 text-safe mode: save -> load -> bit-identical preds,
+    and the file must be single-line printable text after the magic."""
+    dtrain = xgb.DMatrix(AGARICUS_TRAIN)
+    bst = xgb.train({"eta": 1.0, "max_depth": 3,
+                     "objective": "binary:logistic"}, dtrain, 2,
+                    verbose_eval=False)
+    p = str(tmp_path / "m.bs64")
+    bst.save_model(p, save_base64=True)
+    with open(p, "rb") as f:
+        raw = f.read()
+    assert raw[:5] == b"bs64\t"
+    body = raw[5:].rstrip(b"\n")
+    assert all(32 <= c < 127 for c in body)  # survives text channels
+    bst2 = xgb.Booster(model_file=p)
+    dtest = xgb.DMatrix(AGARICUS_TEST, num_col=dtrain.num_col)
+    np.testing.assert_array_equal(bst.predict(dtest), bst2.predict(dtest))
+
+
+def test_cli_save_base64(tmp_path):
+    """CLI save_base64=1 writes the text-safe encoding."""
+    from xgboost_tpu.cli import BoostLearnTask
+    model = str(tmp_path / "cli.bs64")
+    rc = BoostLearnTask().run([
+        f"data={AGARICUS_TRAIN}", "num_round=1", "max_depth=3",
+        "objective=binary:logistic", "silent=2", "save_base64=1",
+        f"model_out={model}"])
+    assert rc == 0
+    with open(model, "rb") as f:
+        assert f.read(5) == b"bs64\t"
+    bst = xgb.Booster(model_file=model)
+    assert bst.predict(xgb.DMatrix(AGARICUS_TEST, num_col=126)).shape == (1611,)

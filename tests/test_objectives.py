@@ -156,3 +156,43 @@ def test_ranking_metrics_at_n():
               evals_result=res, verbose_eval=False)
     assert res["train-ndcg@5"][-1] > 0.8
     assert res["train-pre@2"][-1] > 0.8
+
+
+def test_pratio_metric():
+    """pratio@r = weighted precision in the top r-fraction by prediction
+    (reference EvalPrecisionRatio, evaluation-inl.hpp:302-352)."""
+    from xgboost_tpu.metrics import create_metric
+    preds = np.array([0.9, 0.8, 0.7, 0.6, 0.5, 0.4, 0.3, 0.2, 0.1, 0.0])
+    labels = np.array([1, 1, 0, 1, 0, 0, 0, 0, 0, 1], dtype=np.float64)
+    w = np.ones(10)
+    m = create_metric("pratio@0.4")
+    # top-4: labels 1,1,0,1 -> 3/4
+    assert m(preds, labels, w) == pytest.approx(0.75)
+    # apratio: mean of running precision 1/1, 2/2, 2/3, 3/4
+    m2 = create_metric("apratio@0.4")
+    assert m2(preds, labels, w) == pytest.approx((1 + 1 + 2 / 3 + 0.75) / 4)
+    # weighted: top-2 with weights 3,1 and labels 1,1 -> 1.0
+    m3 = create_metric("pratio@0.2")
+    w2 = np.array([3.0, 1.0] + [1.0] * 8)
+    assert m3(preds, labels, w2) == pytest.approx(1.0)
+
+
+def test_ctest_metric():
+    """ct-<base> (reference EvalCTest, evaluation-inl.hpp:202-240):
+    per-fold held-out evaluation of stacked prediction sets, averaged."""
+    from xgboost_tpu.metrics import create_metric
+    n = 8
+    labels = np.array([0, 1, 0, 1, 0, 1, 0, 1], dtype=np.float64)
+    fold = np.array([0, 0, 0, 0, 1, 1, 1, 1])
+    # head set (full model) is ignored; set k+1 serves fold k
+    head = np.full(n, 0.5)
+    set0 = np.where(np.arange(n) % 2, 0.9, 0.1)   # perfect on fold 0
+    set1 = np.where(np.arange(n) % 2, 0.1, 0.9)   # inverted on fold 1
+    preds = np.concatenate([head, set0, set1])
+    m = create_metric("ct-error")
+    assert getattr(m, "needs_fold_index", False)
+    assert m(preds, labels, np.ones(n), fold_index=fold) == pytest.approx(0.5)
+    with pytest.raises(ValueError):
+        m(preds, labels, np.ones(n), fold_index=None)
+    with pytest.raises(ValueError):
+        m(np.concatenate([head, set0]), labels, np.ones(n), fold_index=fold)

@@ -51,6 +51,7 @@ class BoostLearnTask:
         self.name_pred = "pred.txt"
         self.name_dump = "dump.txt"
         self.checkpoint_dir: Optional[str] = None
+        self.save_base64 = 0  # text-safe model files (reference bs64 mode)
         self.eval_names: List[str] = []
         self.eval_paths: List[str] = []
         self.learner_params: List[Tuple[str, str]] = []
@@ -59,7 +60,7 @@ class BoostLearnTask:
     _OWN = {
         "silent": int, "use_buffer": int, "num_round": int,
         "save_period": int, "eval_train": int, "pred_margin": int,
-        "ntree_limit": int, "dump_stats": int,
+        "ntree_limit": int, "dump_stats": int, "save_base64": int,
     }
 
     def set_param(self, name: str, val: str) -> None:
@@ -163,7 +164,7 @@ class BoostLearnTask:
             path = self.model_out
         else:
             path = os.path.join(self.model_dir, f"{i + 1:04d}.model")
-        bst.save_model(path)
+        bst.save_model(path, save_base64=bool(self.save_base64))
 
     # ------------------------------------------------------------- train
     def task_train(self) -> int:

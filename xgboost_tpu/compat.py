@@ -1,0 +1,223 @@
+"""Reader for the reference's binary model format.
+
+Parses the C-struct model files written by the reference learner
+(``src/learner/learner-inl.hpp:229-234`` SaveModel: LearnerModelParam +
+objective/gbm names, then the booster blob) so models trained by the
+reference CLI can be loaded, cross-checked and served by this framework
+(SURVEY.md §M2).  Both on-disk encodings are handled: raw ``binf`` and
+the base64 text-safe ``bs64`` mode (``learner-inl.hpp:209-252``,
+``src/utils/base64-inl.h``).
+
+Binary layout (all little-endian, struct-aligned as written by the
+reference's ``fo.Write(&param, sizeof(param))``):
+
+- learner ``ModelParam``: float base_score (already margin-transformed,
+  ``learner-inl.hpp:151``), uint num_feature, int num_class, int[31]
+  reserved  (``learner-inl.hpp:427-454``).
+- two length-prefixed strings (uint64 len + bytes): objective name, gbm
+  name.
+- gbtree ``ModelParam`` (``gbtree-inl.hpp:430-484``): int num_trees,
+  num_roots, num_feature, [4B pad], int64 num_pbuffer, int
+  num_output_group, size_leaf_vector, int[31] reserved, [4B pad] — 160
+  bytes total (verified against reference-written files).
+- per tree (``model.h:26-330``): ``Param`` (6 ints + 31 reserved =
+  148B), then num_nodes × ``Node`` {int parent, cleft, cright; uint
+  sindex; float info} (20B), then num_nodes × ``RTreeNodeStat``
+  {float loss_chg, sum_hess, base_weight; int leaf_child_cnt} (16B).
+- int32 tree_info[num_trees] (per-tree class group).
+- optional prediction buffer (ignored).
+
+The converted ensemble is exact: per-feature cut sets are the model's
+own distinct thresholds, so the binned traversal ``bin(v) <= j+1``
+reproduces the reference's ``fvalue < split_cond`` routing bit-for-bit
+(``model.h:534-566``), including the missing-value default direction
+carried in sindex's top bit.
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+_LEARNER_PARAM = struct.Struct("<fIi124x")
+_GBTREE_PARAM = struct.Struct("<iii4xqii128x")
+_TREE_PARAM = struct.Struct("<6i124x")
+_GBLINEAR_PARAM = struct.Struct("<Ii128x")
+_NODE_DT = np.dtype([("parent", "<i4"), ("cleft", "<i4"), ("cright", "<i4"),
+                     ("sindex", "<u4"), ("info", "<f4")])
+_STAT_DT = np.dtype([("loss_chg", "<f4"), ("sum_hess", "<f4"),
+                     ("base_weight", "<f4"), ("leaf_child_cnt", "<i4")])
+
+
+def _read_str(data: bytes, off: int):
+    (ln,) = struct.unpack_from("<Q", data, off)
+    off += 8
+    if ln >= (1 << 32):  # old-format compat gap (learner-inl.hpp:171-175)
+        off += 4
+        ln >>= 32
+    s = data[off:off + ln].decode()
+    return s, off + ln
+
+
+def parse_reference_model(data: bytes) -> dict:
+    """Parse reference model bytes into a plain dict (format-level only)."""
+    if data[:4] == b"bs64":
+        data = base64.b64decode(b"".join(data[5:].split()))
+    elif data[:4] == b"binf":
+        data = data[4:]
+    # else: headerless pre-magic stream, parse from byte 0
+    base_margin, num_feature, num_class = _LEARNER_PARAM.unpack_from(data, 0)
+    off = _LEARNER_PARAM.size
+    name_obj, off = _read_str(data, off)
+    name_gbm, off = _read_str(data, off)
+    out = {"base_margin": base_margin, "num_feature": num_feature,
+           "num_class": num_class, "objective": name_obj, "gbm": name_gbm}
+    if name_gbm == "gblinear":
+        nf, nog = _GBLINEAR_PARAM.unpack_from(data, off)
+        off += _GBLINEAR_PARAM.size
+        (wlen,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        w = np.frombuffer(data, "<f4", count=wlen, offset=off)
+        out["num_output_group"] = nog
+        out["weights"] = w.reshape(nf + 1, nog).astype(np.float32)
+        return out
+    if name_gbm != "gbtree":
+        raise ValueError(f"unknown booster in reference model: {name_gbm!r}")
+    num_trees, _roots, gb_nf, _npb, nog, slv = _GBTREE_PARAM.unpack_from(
+        data, off)
+    off += _GBTREE_PARAM.size
+    if slv != 0:
+        raise ValueError("size_leaf_vector != 0 models are not supported")
+    trees = []
+    for _ in range(num_trees):
+        _, n_nodes, _, _, _, t_slv = _TREE_PARAM.unpack_from(data, off)
+        off += _TREE_PARAM.size
+        nodes = np.frombuffer(data, _NODE_DT, count=n_nodes, offset=off)
+        off += _NODE_DT.itemsize * n_nodes
+        stats = np.frombuffer(data, _STAT_DT, count=n_nodes, offset=off)
+        off += _STAT_DT.itemsize * n_nodes
+        if t_slv:
+            (lv_len,) = struct.unpack_from("<Q", data, off)
+            off += 8 + 4 * lv_len
+        trees.append((nodes, stats))
+    tree_info = np.frombuffer(data, "<i4", count=num_trees, offset=off)
+    out["num_output_group"] = max(1, nog)
+    out["trees"] = trees
+    out["tree_info"] = tree_info.astype(np.int32)
+    return out
+
+
+def _tree_depth(nodes: np.ndarray) -> int:
+    depth, frontier = 0, [(0, 0)]
+    best = 0
+    while frontier:
+        nid, d = frontier.pop()
+        best = max(best, d)
+        if nodes["cleft"][nid] != -1:
+            frontier.append((int(nodes["cleft"][nid]), d + 1))
+            frontier.append((int(nodes["cright"][nid]), d + 1))
+    return best
+
+
+def load_reference_model(src):
+    """Load a reference-format model (file path or raw ``bytes``) into a
+    served-ready Booster."""
+    import jax.numpy as jnp
+
+    from xgboost_tpu.binning import CutMatrix, pack_cuts
+    from xgboost_tpu.learner import Booster
+    from xgboost_tpu.models.tree import TreeArrays, tree_capacity
+
+    if isinstance(src, bytes):
+        parsed = parse_reference_model(src)
+    else:
+        with open(src, "rb") as f:
+            parsed = parse_reference_model(f.read())
+
+    params = {"objective": parsed["objective"],
+              "num_class": parsed["num_class"]}
+    if parsed["gbm"] == "gblinear":
+        params["booster"] = "gblinear"
+        bst = Booster(params)  # num_output_group derives from num_class
+        bst._init_obj()
+        bst.num_feature = parsed["num_feature"]
+        from xgboost_tpu.models.gblinear import GBLinear
+        gbl = GBLinear(bst.param, parsed["num_feature"])
+        # reference layout: weight[(num_feature+1) * K], bias LAST
+        # (gblinear-inl.hpp:252-259)
+        gbl.weight = jnp.asarray(parsed["weights"][:-1])
+        gbl.bias = jnp.asarray(parsed["weights"][-1])
+        bst.gbtree = gbl
+        bst.param.base_score = _margin_to_base_score(
+            bst.obj, parsed["base_margin"])
+        return bst
+
+    trees, tree_info = parsed["trees"], parsed["tree_info"]
+    nf = parsed["num_feature"]
+    # cuts = the model's own thresholds per feature -> exact traversal
+    thresholds: List[List[float]] = [[] for _ in range(nf)]
+    for nodes, _ in trees:
+        split = nodes["cleft"] != -1
+        for f, thr in zip(nodes["sindex"][split] & 0x7FFFFFFF,
+                          nodes["info"][split]):
+            thresholds[int(f)].append(np.float32(thr))
+    per_feature = [np.unique(np.asarray(t, np.float32)) if t
+                   else np.asarray([np.float32("inf")])
+                   for t in thresholds]
+    cuts = pack_cuts(per_feature)
+
+    max_depth = max((_tree_depth(n) for n, _ in trees), default=1)
+    max_depth = max(max_depth, 1)
+    params["max_depth"] = max_depth
+    bst = Booster(params)  # num_output_group derives from num_class
+    bst._init_obj()
+    bst.num_feature = nf
+    from xgboost_tpu.models.gbtree import GBTree
+    gbt = GBTree(bst.param, cuts)
+    cap = tree_capacity(max_depth)
+    for nodes, stats in trees:
+        arr = {"feature": np.full(cap, -1, np.int32),
+               "cut_index": np.zeros(cap, np.int32),
+               "threshold": np.zeros(cap, np.float32),
+               "default_left": np.zeros(cap, bool),
+               "is_leaf": np.zeros(cap, bool),
+               "leaf_value": np.zeros(cap, np.float32),
+               "gain": np.zeros(cap, np.float32),
+               "sum_hess": np.zeros(cap, np.float32)}
+        frontier = [(0, 0)]  # (reference nid, perfect-layout slot)
+        while frontier:
+            nid, slot = frontier.pop()
+            arr["sum_hess"][slot] = stats["sum_hess"][nid]
+            arr["leaf_value"][slot] = stats["base_weight"][nid]
+            if nodes["cleft"][nid] == -1:
+                arr["is_leaf"][slot] = True
+                arr["leaf_value"][slot] = nodes["info"][nid]
+                continue
+            f = int(nodes["sindex"][nid] & 0x7FFFFFFF)
+            thr = np.float32(nodes["info"][nid])
+            arr["feature"][slot] = f
+            arr["threshold"][slot] = thr
+            arr["cut_index"][slot] = int(np.searchsorted(
+                cuts.cut_values[f, :cuts.n_cuts[f]], thr))
+            arr["default_left"][slot] = bool(nodes["sindex"][nid] >> 31)
+            arr["gain"][slot] = stats["loss_chg"][nid]
+            frontier.append((int(nodes["cleft"][nid]), 2 * slot + 1))
+            frontier.append((int(nodes["cright"][nid]), 2 * slot + 2))
+        gbt.trees.append(TreeArrays(**{k: jnp.asarray(v)
+                                       for k, v in arr.items()}))
+    gbt.tree_group = [int(g) for g in tree_info]
+    bst.gbtree = gbt
+    bst.param.base_score = _margin_to_base_score(
+        bst.obj, parsed["base_margin"])
+    return bst
+
+
+def _margin_to_base_score(obj, margin: float) -> float:
+    """Invert prob_to_margin: the reference stores base_score already
+    margin-transformed (learner-inl.hpp:151)."""
+    if obj.prob_to_margin(0.3) == 0.3:  # identity transform family
+        return float(margin)
+    return float(1.0 / (1.0 + np.exp(-margin)))  # logistic family

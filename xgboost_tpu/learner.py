@@ -440,7 +440,11 @@ class Booster:
             gptr = dmat.info.group_ptr
             for m in self._metrics(feval):
                 p = tr if tr.shape[1] > 1 else tr[:, 0]
-                val = m(p, labels, weights, gptr)
+                if getattr(m, "needs_fold_index", False):
+                    val = m(p, labels, weights, gptr,
+                            fold_index=dmat.info.fold_index)
+                else:
+                    val = m(p, labels, weights, gptr)
                 parts.append(f"{name}-{m.metric_name}:{val:.6f}")
             if feval is not None:
                 # feval comes LAST so early stopping tracks it (reference
@@ -454,7 +458,10 @@ class Booster:
         return self.eval_set([(data, name)], iteration)
 
     # ---------------------------------------------------------- model store
-    def save_model(self, path: str):
+    def save_model(self, path: str, save_base64: bool = False):
+        """Save the model; ``save_base64`` writes the text-safe encoding
+        (the reference's ``bs64`` mode, learner-inl.hpp:240-252, which
+        survives text-only channels)."""
         assert self.gbtree is not None, "nothing to save"
         header = {
             "magic": _MAGIC,
@@ -466,13 +473,42 @@ class Booster:
             "best_iteration": self.best_iteration,
         }
         state = self.gbtree.get_state()
+        if save_base64:
+            import base64
+            import io
+            buf = io.BytesIO()
+            np.savez(buf, header=np.frombuffer(
+                json.dumps(header).encode(), dtype=np.uint8), **state)
+            with open(path, "wb") as f:
+                f.write(b"bs64\t")
+                f.write(base64.b64encode(buf.getvalue()))
+                f.write(b"\n")
+            return
         with open(path, "wb") as f:
             np.savez(f, header=np.frombuffer(
                 json.dumps(header).encode(), dtype=np.uint8), **state)
 
     def load_model(self, path: str):
+        with open(path, "rb") as f:
+            head = f.read(5)
+        if head[:4] in (b"binf", b"bs64") and head != b"bs64\t":
+            # reference binary format (binf, or bs64 of the reference
+            # stream): delegate to the compat reader
+            self._load_reference(path)
+            return
+        if head == b"bs64\t":
+            import base64
+            import io
+            with open(path, "rb") as f:
+                raw = base64.b64decode(b"".join(f.read()[5:].split()))
+            if not raw.startswith(b"PK"):  # not our npz: reference stream
+                self._load_reference(raw)
+                return
+            src = io.BytesIO(raw)
+        else:
+            src = path
         try:
-            z = np.load(path, allow_pickle=False)
+            z = np.load(src, allow_pickle=False)
         except Exception as e:
             raise ValueError(f"{path} is not an xgboost_tpu model file: {e}")
         with z:
@@ -490,6 +526,16 @@ class Booster:
         else:
             from xgboost_tpu.models.gbtree import GBTree
             self.gbtree = GBTree.from_state(self.param, state)
+        self._cache.clear()
+
+    def _load_reference(self, src):
+        """Adopt the state of a reference-format model (path or bytes)."""
+        from xgboost_tpu.compat import load_reference_model
+        other = load_reference_model(src)
+        self.param = other.param
+        self.obj = other.obj
+        self.gbtree = other.gbtree
+        self.num_feature = other.num_feature
         self._cache.clear()
 
     def save_raw(self) -> bytes:

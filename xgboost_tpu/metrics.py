@@ -117,6 +117,69 @@ def ams(preds, labels, weights, group_ptr=None, ratio: float = 0.15):
     return float(np.sqrt(max(val, 0.0)))
 
 
+# --------------------------------------------------- precision-ratio family
+
+def precision_ratio(preds, labels, weights, group_ptr=None,
+                    ratio: float = 0.1, use_ap: bool = False):
+    """Precision in the top ``ratio`` fraction by prediction
+    (reference EvalPrecisionRatio, evaluation-inl.hpp:302-352):
+    ``pratio@r`` is the weighted hit rate within the cutoff; ``apratio@r``
+    averages the running precision over every rank up to the cutoff.
+
+    Deviation: the reference weights position ``j`` of the *sorted* list
+    with ``GetWeight(j)`` — i.e. the weight of an unrelated row
+    (evaluation-inl.hpp:340) — which only coincides with instance weights
+    when all weights are equal.  We weight the selected instance itself.
+    """
+    preds = preds.ravel()
+    order = np.argsort(-preds, kind="stable")
+    cutoff = int(ratio * len(preds))
+    if cutoff == 0:
+        return 0.0
+    sel = order[:cutoff]
+    w = weights[sel]
+    hit = np.cumsum(labels[sel] * w)
+    wsum = np.cumsum(w)
+    if use_ap:
+        return float(np.mean(hit / wsum))
+    return float(hit[-1] / wsum[-1])
+
+
+# ------------------------------------------------------- cross-fold ctest
+
+def ctest(base_fn, preds, labels, weights, fold_index):
+    """Cross-validation test metric ``ct-<base>`` (reference EvalCTest,
+    evaluation-inl.hpp:202-240): predictions carry ``ngroup+1`` stacked
+    prediction sets of ``ndata`` each (the head set is the full model;
+    set ``k+1`` is the model that held out fold ``k``); the base metric is
+    evaluated per fold on its held-out rows and averaged over folds."""
+    preds = np.asarray(preds)
+    if preds.ndim != 1:
+        raise ValueError(
+            "ct-: expects 1D stacked prediction sets (got shape "
+            f"{preds.shape}); multiclass per-class outputs are not a "
+            "fold-stacked layout")
+    n = len(labels)
+    if preds.size % n != 0:
+        raise ValueError("ct-: label and prediction size not match")
+    ngroup = preds.size // n - 1
+    if ngroup <= 1:
+        raise ValueError("ct-: pred size does not meet requirement")
+    if fold_index is None or len(fold_index) != n:
+        raise ValueError("ct-: need fold index")
+    fold_index = np.asarray(fold_index)
+    wsum = 0.0
+    for k in range(ngroup):
+        mask = fold_index == k
+        if not mask.any():
+            raise ValueError(
+                f"ct-: fold {k} has no rows — fold_index must be 0-based "
+                f"ids in [0, {ngroup})")
+        wsum += base_fn(preds[(k + 1) * n:(k + 2) * n][mask],
+                        labels[mask], weights[mask], None)
+    return float(wsum / ngroup)
+
+
 # ------------------------------------------------------- ranklist metrics
 
 def _dcg_at(rels: np.ndarray, n: int) -> float:
@@ -185,6 +248,13 @@ def create_metric(name: str) -> Callable:
 
     Supports suffixed names: ``ndcg@10``, ``map@5-``, ``pre@3``, ``ams@0.15``.
     """
+    if name.startswith("ct-"):
+        base_fn = create_metric(name[3:])
+        wrapped = _named(
+            lambda p, l, w, g=None, fold_index=None: ctest(
+                base_fn, p, l, w, fold_index), name)
+        wrapped.needs_fold_index = True
+        return wrapped
     base, at, suffix = name.partition("@")
     minus = False
     if suffix.endswith("-"):
@@ -198,6 +268,11 @@ def create_metric(name: str) -> Callable:
     if base == "ams":
         ratio = float(suffix) if suffix else 0.15
         return _named(lambda p, l, w, g=None: ams(p, l, w, g, ratio), name)
+    if base in ("pratio", "apratio"):
+        ratio = float(suffix) if suffix else 0.1
+        use_ap = base == "apratio"
+        return _named(lambda p, l, w, g=None: precision_ratio(
+            p, l, w, g, ratio, use_ap), name)
     topn = int(float(suffix)) if suffix else 0
     rankers = {"ndcg": ndcg, "map": map_metric, "pre": precision_at}
     if base in rankers:
