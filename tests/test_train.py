@@ -113,6 +113,25 @@ def test_early_stopping(agaricus):
     assert bst.best_score < 0.1
 
 
+def test_profile_round_breakdown(agaricus, capsys):
+    """profile=1 emits per-round phase timing + summary (SURVEY.md §5.1
+    report_stats analog) without changing results."""
+    dtrain, dtest = agaricus
+    params = {"eta": 1.0, "max_depth": 3, "objective": "binary:logistic"}
+    p_plain = xgb.train(params, dtrain, 2, verbose_eval=False).predict(dtest)
+    bst = xgb.train({**params, "profile": 1}, xgb.DMatrix(AGARICUS_TRAIN), 2,
+                    evals=[(dtest, "eval")], verbose_eval=False)
+    err = capsys.readouterr().err
+    assert "[prof] round 0:" in err and "grow=" in err
+    assert "[prof]   grow" in err and "ms/round" in err
+    assert "eval" in err
+    prof = bst._profiler
+    assert len(prof.rounds) == 2
+    assert all("grow" in r["phases"] for r in prof.rounds)
+    np.testing.assert_allclose(bst.predict(dtest), p_plain,
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_weights_affect_training():
     rng = np.random.RandomState(1)
     X = rng.rand(500, 3).astype(np.float32)

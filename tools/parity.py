@@ -1,0 +1,381 @@
+"""Head-to-head parity harness: reference C++ CLI vs this framework.
+
+Runs the five BASELINE.json configs on identical data and records both
+sides' metrics (and train wall-clock) into ``PARITY.json`` +
+``PARITY.md`` at the repo root.  Public data beyond agaricus is not
+bundled with the reference, so higgs/dermatology/rank configs use
+deterministic synthetic datasets written to libsvm files that BOTH
+binaries read (the comparison is still reference-vs-us on identical
+inputs; only the absolute metric values differ from the historical
+Kaggle numbers).
+
+The reference binary is built from ``/root/reference`` into the scratch
+dir with flags that let the 2014-era C++ compile under a modern g++
+(``-std=gnu++98 -fpermissive``).
+
+Usage:
+  python tools/parity.py [--workdir DIR] [--skip-baseline]
+  python tools/parity.py --baseline1m   # reference Higgs-1M CPU rate only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = os.environ.get("XGTPU_REFERENCE", "/root/reference")
+AGARICUS_TRAIN = f"{REFERENCE}/demo/data/agaricus.txt.train"
+AGARICUS_TEST = f"{REFERENCE}/demo/data/agaricus.txt.test"
+
+sys.path.insert(0, REPO)
+
+
+# ------------------------------------------------------------ reference build
+
+def build_reference(workdir: str) -> str:
+    """Build the reference CLI binary in <workdir>/refbuild; returns path."""
+    build = os.path.join(workdir, "refbuild")
+    binary = os.path.join(build, "xgboost")
+    if os.path.exists(binary):
+        return binary
+    print("[parity] building reference binary...", flush=True)
+    if not os.path.exists(build):
+        shutil.copytree(REFERENCE, build)
+    flags = ("-O3 -msse2 -Wno-unknown-pragmas -fPIC -std=gnu++98 "
+             "-fpermissive -w -fopenmp")
+    subprocess.run(["make", "xgboost", f"CFLAGS={flags}"], cwd=build,
+                   check=True, capture_output=True, timeout=600)
+    return binary
+
+
+# ------------------------------------------------------------------- datasets
+
+def _write_libsvm(path: str, X, y, fmt: str = "%.6g"):
+    import numpy as np
+    with open(path, "w") as f:
+        for i in range(X.shape[0]):
+            feats = " ".join(f"{j}:{fmt % v}" for j, v in enumerate(X[i]))
+            f.write(f"{fmt % y[i]} {feats}\n")
+
+
+def make_higgs(workdir: str, n: int, tag: str):
+    """Synthetic Higgs-like binary data (same generator as bench.py)."""
+    train = os.path.join(workdir, f"higgs{tag}.train")
+    test = os.path.join(workdir, f"higgs{tag}.test")
+    if os.path.exists(train) and os.path.exists(test):
+        return train, test
+    sys.path.insert(0, REPO)
+    from bench import make_higgs_like
+    X, y = make_higgs_like(n + max(50_000, n // 5))
+    print(f"[parity] writing {train} ...", flush=True)
+    _write_libsvm(train, X[:n], y[:n])
+    _write_libsvm(test, X[n:], y[n:])
+    return train, test
+
+
+def make_dermatology(workdir: str):
+    """Synthetic 6-class dermatology-like data (34 ordinal features)."""
+    import numpy as np
+    train = os.path.join(workdir, "derma.train")
+    test = os.path.join(workdir, "derma.test")
+    if os.path.exists(train):
+        return train, test
+    rng = np.random.RandomState(7)
+    n = 2000
+    centers = rng.randint(0, 4, size=(6, 34))
+    y = rng.randint(0, 6, size=n)
+    X = np.clip(centers[y] + rng.randint(-1, 2, size=(n, 34))
+                + (rng.rand(n, 34) < 0.1) * rng.randint(0, 4, size=(n, 34)),
+                0, 3).astype(np.float32)
+    cut = int(n * 0.7)
+    _write_libsvm(train, X[:cut], y[:cut], fmt="%g")
+    _write_libsvm(test, X[cut:], y[cut:], fmt="%g")
+    return train, test
+
+
+def make_rank(workdir: str):
+    """Synthetic MQ2008-like ranking data: 300 train / 100 test groups of
+    8-24 docs, 46 features, graded relevance 0-2, plus .group sidecars."""
+    import numpy as np
+    train = os.path.join(workdir, "mq.train")
+    test = os.path.join(workdir, "mq.test")
+    if os.path.exists(train):
+        return train, test
+    rng = np.random.RandomState(11)
+    w = rng.randn(46)
+    for path, n_groups in ((train, 300), (test, 100)):
+        rows, labels, sizes = [], [], []
+        for _ in range(n_groups):
+            g = rng.randint(8, 25)
+            Xg = rng.randn(g, 46).astype(np.float32)
+            score = Xg @ w + 1.5 * rng.randn(g)
+            rel = np.zeros(g)
+            order = np.argsort(-score)
+            rel[order[: max(1, g // 6)]] = 2
+            rel[order[max(1, g // 6): max(2, g // 3)]] = 1
+            rows.append(Xg)
+            labels.append(rel)
+            sizes.append(g)
+        X = np.concatenate(rows)
+        y = np.concatenate(labels)
+        _write_libsvm(path, X, y, fmt="%.5g")
+        with open(path + ".group", "w") as f:
+            f.write("\n".join(str(s) for s in sizes) + "\n")
+    return train, test
+
+
+# ------------------------------------------------------------------- running
+
+def _parse_evals(text: str):
+    """Parse '[i]\\tname-metric:value' lines -> {name-metric: [values]}."""
+    out = {}
+    for line in text.splitlines():
+        if not line.startswith("["):
+            continue
+        for part in line.split("\t")[1:]:
+            k, _, v = part.rpartition(":")
+            try:
+                out.setdefault(k.strip(), []).append(float(v))
+            except ValueError:
+                pass
+    return out
+
+
+def _parse_train_time(text: str):
+    m = re.search(r"updating end, (\d+) sec in all", text)
+    return int(m.group(1)) if m else None
+
+
+def _conf(cwd: str) -> str:
+    """Both CLIs take a config file as the first argument; share one."""
+    path = os.path.join(cwd, "parity.conf")
+    if not os.path.exists(path):
+        with open(path, "w") as f:
+            f.write("task = train\n")
+    return path
+
+
+def run_reference(binary: str, args: list, cwd: str, timeout=3600):
+    t0 = time.perf_counter()
+    r = subprocess.run([binary, _conf(cwd)] + args, cwd=cwd,
+                       capture_output=True, text=True, timeout=timeout)
+    wall = time.perf_counter() - t0
+    if r.returncode != 0:
+        raise RuntimeError(f"reference failed: {r.stderr[-800:]}")
+    text = r.stdout + "\n" + r.stderr
+    return _parse_evals(text), _parse_train_time(text), wall
+
+
+def run_ours(args: list, cwd: str, timeout=3600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    t0 = time.perf_counter()
+    r = subprocess.run([sys.executable, "-m", "xgboost_tpu", _conf(cwd)]
+                       + args, cwd=cwd, capture_output=True, text=True,
+                       timeout=timeout, env=env)
+    wall = time.perf_counter() - t0
+    if r.returncode != 0:
+        raise RuntimeError(f"ours failed: {r.stderr[-800:]}")
+    text = r.stdout + "\n" + r.stderr
+    return _parse_evals(text), _parse_train_time(text), wall
+
+
+def _common_args(train, test, extra):
+    return ([f"data={train}", f"eval[test]={test}", "eval_train=1",
+             "model_out=NONE", "silent=0"] + extra)
+
+
+def compare(name, ref_bin, workdir, train, test, extra, rounds,
+            results, timeout=3600):
+    args = _common_args(train, test, extra) + [f"num_round={rounds}"]
+    print(f"[parity] {name}: reference ...", flush=True)
+    r_ev, r_tt, r_wall = run_reference(ref_bin, args, workdir,
+                                       timeout=timeout)
+    print(f"[parity] {name}: ours ...", flush=True)
+    o_ev, o_tt, o_wall = run_ours(args, workdir, timeout=timeout)
+    entry = {"rounds": rounds, "reference": {}, "ours": {},
+             "reference_train_sec": r_tt if r_tt is not None else r_wall,
+             "ours_train_sec": o_tt if o_tt is not None else o_wall}
+    for k, v in r_ev.items():
+        entry["reference"][k] = v[-1]
+    for k, v in o_ev.items():
+        entry["ours"][k] = v[-1]
+    results[name] = entry
+    print(f"[parity] {name}: ref={entry['reference']} "
+          f"ours={entry['ours']}", flush=True)
+    return entry
+
+
+def baseline_1m(ref_bin: str, workdir: str, rounds: int = 20):
+    """Measure the reference's single-core Higgs-1M training rate."""
+    train, test = make_higgs(workdir, 1_000_000, "1m")
+    args = [f"data={train}", "model_out=NONE", "silent=0",
+            "objective=binary:logistic", "max_depth=6", "eta=0.1",
+            f"num_round={rounds}", "use_buffer=0"]
+    print("[parity] measuring reference Higgs-1M CPU rate "
+          f"({rounds} rounds, 1 thread)...", flush=True)
+    _, train_sec, wall = run_reference(ref_bin, args, workdir,
+                                       timeout=7200)
+    sec = train_sec if train_sec else wall
+    rate = 1_000_000 * rounds / max(sec, 1)
+    return {"rows": 1_000_000, "rounds": rounds, "train_sec": sec,
+            "rows_per_sec_1thread": rate, "nthread": 1}
+
+
+# --------------------------------------------------------------------- report
+
+def write_report(results: dict):
+    with open(os.path.join(REPO, "PARITY.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    lines = [
+        "# PARITY — reference C++ CLI vs xgboost_tpu on identical data",
+        "",
+        "Produced by `python tools/parity.py` on this host "
+        "(reference built from `/root/reference`, single-core CPU; "
+        "ours run with JAX_PLATFORMS=cpu for metric parity — TPU "
+        "throughput is bench.py's job).  Synthetic stand-ins are used "
+        "where the reference demo data is not bundled (higgs/derma/rank); "
+        "both sides read the same libsvm files.",
+        "",
+        "| config | metric | reference | ours | ref sec | ours sec* |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name, e in results.items():
+        if name == "baseline_1m":
+            continue
+        keys = sorted(set(e["reference"]) & set(e["ours"]))
+        for i, k in enumerate(keys):
+            tail = (f"{e['reference_train_sec']:.0f} | "
+                    f"{e['ours_train_sec']:.0f}" if i == 0 else " | ")
+            lines.append(f"| {name if i == 0 else ''} | {k} | "
+                         f"{e['reference'][k]:.6f} | {e['ours'][k]:.6f} | "
+                         f"{tail} |")
+    if "baseline_1m" in results:
+        b = results["baseline_1m"]
+        lines += [
+            "",
+            "## Measured CPU baseline (anchors bench.py)",
+            "",
+            f"Reference CLI, Higgs-1M x 28, depth 6, eta 0.1, "
+            f"{b['rounds']} rounds, **1 thread** (this host has 1 core): "
+            f"{b['train_sec']:.0f} s -> "
+            f"**{b['rows_per_sec_1thread']:,.0f} rows/s/thread**.",
+            "",
+            "bench.py projects this to a 16-thread CPU with perfect "
+            "linear scaling (generous to the reference — real scaling is "
+            "sublinear) and uses `max(8e4, measured x 16)` as the "
+            "baseline denominator.",
+        ]
+    lines += [
+        "",
+        "*ours-CPU train sec includes one-off jit compilation (~10-40 s) "
+        "and is not the performance claim; see BENCH_r*.json for TPU "
+        "throughput.",
+        "",
+    ]
+    with open(os.path.join(REPO, "PARITY.md"), "w") as f:
+        f.write("\n".join(lines))
+    print("[parity] wrote PARITY.json + PARITY.md", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", default="/tmp/xgtpu_parity")
+    ap.add_argument("--skip-baseline", action="store_true")
+    ap.add_argument("--baseline1m", action="store_true",
+                    help="only (re)measure the reference 1M CPU rate")
+    ap.add_argument("--higgs-rounds", type=int, default=20)
+    args = ap.parse_args()
+    os.makedirs(args.workdir, exist_ok=True)
+    ref_bin = build_reference(args.workdir)
+
+    results = {}
+    parity_path = os.path.join(REPO, "PARITY.json")
+    if os.path.exists(parity_path):
+        with open(parity_path) as f:
+            results = json.load(f)
+
+    if args.baseline1m:
+        results["baseline_1m"] = baseline_1m(ref_bin, args.workdir)
+        write_report(results)
+        return
+
+    # 1. agaricus (demo/binary_classification mushroom.conf params)
+    compare("agaricus", ref_bin, args.workdir,
+            AGARICUS_TRAIN, AGARICUS_TEST,
+            ["objective=binary:logistic", "max_depth=3", "eta=1.0",
+             "gamma=1.0", "min_child_weight=1", "use_buffer=0"],
+            rounds=2, results=results)
+
+    # 2. higgs 250k (demo/kaggle-higgs params; auc on held-out)
+    tr, te = make_higgs(args.workdir, 250_000, "250k")
+    compare("higgs250k", ref_bin, args.workdir, tr, te,
+            ["objective=binary:logitraw", "max_depth=6", "eta=0.1",
+             "eval_metric=auc", "use_buffer=0"],
+            rounds=args.higgs_rounds, results=results, timeout=7200)
+
+    # 3. dermatology-like 6-class softmax (demo/multiclass params)
+    tr, te = make_dermatology(args.workdir)
+    compare("dermatology6", ref_bin, args.workdir, tr, te,
+            ["objective=multi:softmax", "num_class=6", "max_depth=6",
+             "eta=0.1", "use_buffer=0"],
+            rounds=5, results=results)
+
+    # 4. rank (demo/rank mq2008.conf params + ndcg)
+    tr, te = make_rank(args.workdir)
+    compare("rank_pairwise", ref_bin, args.workdir, tr, te,
+            ["objective=rank:pairwise", "max_depth=6", "eta=0.1",
+             "gamma=1.0", "min_child_weight=0.1", "eval_metric=ndcg",
+             "use_buffer=0"],
+            rounds=4, results=results)
+
+    # 5. col-split (multi-node/col-split mushroom config): ours shards
+    # features over 8 virtual devices; the reference result is the
+    # equivalent single-process run (its distributed col-split is defined
+    # to reproduce the single model; ours is bit-match tested in
+    # tests/test_distributed.py).
+    env_extra = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    args5 = _common_args(
+        AGARICUS_TRAIN, AGARICUS_TEST,
+        ["objective=binary:logistic", "max_depth=3", "eta=1.0",
+         "gamma=1.0", "min_child_weight=1", "use_buffer=0",
+         "num_round=2"])
+    print("[parity] colsplit: reference (single-process equivalent) ...",
+          flush=True)
+    r_ev, r_tt, r_wall = run_reference(ref_bin, args5, args.workdir)
+    print("[parity] colsplit: ours dsplit=col over 8 shards ...", flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO, **env_extra)
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, "-m", "xgboost_tpu", _conf(args.workdir)] + args5 +
+        ["dsplit=col", "updater=grow_colmaker,prune"],
+        cwd=args.workdir, capture_output=True, text=True, env=env,
+        timeout=3600)
+    if r.returncode != 0:
+        raise RuntimeError(f"ours colsplit failed: {r.stderr[-800:]}")
+    o_ev = _parse_evals(r.stdout + "\n" + r.stderr)
+    o_tt = _parse_train_time(r.stdout + "\n" + r.stderr)
+    entry = {"rounds": 2,
+             "reference": {k: v[-1] for k, v in r_ev.items()},
+             "ours": {k: v[-1] for k, v in o_ev.items()},
+             "reference_train_sec": r_tt if r_tt is not None else r_wall,
+             "ours_train_sec": o_tt if o_tt is not None else
+             time.perf_counter() - t0}
+    results["colsplit_mushroom"] = entry
+    print(f"[parity] colsplit: ref={entry['reference']} "
+          f"ours={entry['ours']}", flush=True)
+
+    if not args.skip_baseline and "baseline_1m" not in results:
+        results["baseline_1m"] = baseline_1m(ref_bin, args.workdir)
+
+    write_report(results)
+
+
+if __name__ == "__main__":
+    main()

@@ -189,7 +189,10 @@ class BoostLearnTask:
                       "elapsed", file=sys.stderr)
             bst.update(data, i)
             if evals:
-                msg = bst.eval_set(evals, i)
+                from contextlib import nullcontext
+                prof = bst.profiler
+                with prof.phase("eval") if prof else nullcontext():
+                    msg = bst.eval_set(evals, i)
                 if self.silent < 2:
                     print(msg, file=sys.stderr)
             if self.save_period != 0 and (i + 1) % self.save_period == 0:
@@ -205,6 +208,9 @@ class BoostLearnTask:
                 self._save(bst)
             else:
                 self._save(bst, self.num_round - 1)
+        if getattr(bst, "_profiler", None) is not None:
+            bst._profiler.print_summary()
+            bst._profiler.stop()
         if not self.silent:
             print(f"\nupdating end, {time.time() - start:.0f} sec in all",
                   file=sys.stderr)

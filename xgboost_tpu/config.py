@@ -73,6 +73,10 @@ class TrainParam:
     dsplit: str = "auto"  # auto | row | col
     nthread: int = 0
     silent: int = 0
+    # profiling (SURVEY.md §5.1): 1 = per-round phase timing,
+    # 2 = also capture a jax.profiler trace into profile_dir
+    profile: int = 0
+    profile_dir: str = ""
 
     # -- gblinear params (reference src/gbm/gblinear-inl.hpp) --
     lambda_bias: float = 0.0

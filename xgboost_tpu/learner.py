@@ -263,17 +263,51 @@ class Booster:
             entry.margin[start:start + batch.shape[0]] += np.asarray(m)
         entry.applied = self.gbtree.num_trees
 
+    # ------------------------------------------------------------ profiling
+    @property
+    def profiler(self):
+        """Lazily created RoundProfiler when param profile>=1 (the
+        report_stats analog, SURVEY.md §5.1)."""
+        if self.param.profile <= 0:
+            return None
+        if getattr(self, "_profiler", None) is None:
+            from xgboost_tpu.profiling import RoundProfiler
+            self._profiler = RoundProfiler(
+                self.param.profile, self.param.profile_dir or None)
+            self._profiler.start()
+        return self._profiler
+
     # ------------------------------------------------------------- training
     def update(self, dtrain: DMatrix, iteration: int, fobj=None):
         """One boosting round (reference BoostLearner::UpdateOneIter,
         learner-inl.hpp:274-281; custom-objective path Booster.update,
         wrapper/xgboost.py:335-355)."""
+        prof = self.profiler
+        if prof is None:
+            return self._update(dtrain, iteration, fobj)
+        prof.begin_round(iteration)
+        try:
+            return self._update(dtrain, iteration, fobj, prof)
+        finally:
+            prof.end_round()
+
+    def _update(self, dtrain: DMatrix, iteration: int, fobj=None, prof=None):
+        from contextlib import nullcontext
+        ph = (lambda name: prof.phase(name)) if prof else \
+            (lambda name: nullcontext())
         self._lazy_init(dtrain)
-        entry = self._entry(dtrain)
-        self._sync_margin(entry)
+        with ph("predict") as p:
+            entry = self._entry(dtrain)
+            self._sync_margin(entry)
+            if prof:
+                p.block(entry.margin)
         if fobj is None:
-            gh = self.obj.get_gradient(jnp.asarray(entry.margin), entry.info,
-                                       iteration, entry.margin.shape[0])
+            with ph("gradient") as p:
+                gh = self.obj.get_gradient(
+                    jnp.asarray(entry.margin), entry.info,
+                    iteration, entry.margin.shape[0])
+                if prof:
+                    p.block(gh)
         else:
             # custom objective sees only the real rows; gradients are
             # zero-padded back to the device row count below in boost()
@@ -283,7 +317,10 @@ class Booster:
                 pred = pred[:, 0]
             grad, hess = fobj(pred, dtrain)
             return self.boost(dtrain, grad, hess)
-        self._do_boost(dtrain, entry, gh, iteration)
+        with ph("grow") as p:
+            self._do_boost(dtrain, entry, gh, iteration)
+            if prof and entry.margin is not None:
+                p.block(entry.margin)
 
     def boost(self, dtrain: DMatrix, grad, hess):
         """Boost from user-supplied gradients (reference
@@ -473,16 +510,22 @@ class Booster:
             "best_iteration": self.best_iteration,
         }
         state = self.gbtree.get_state()
-        if save_base64:
+        if save_base64 or path == "stdout":
+            # stdout is always base64, like the reference
+            # (learner-inl.hpp:240-243)
             import base64
             import io
+            import sys
             buf = io.BytesIO()
             np.savez(buf, header=np.frombuffer(
                 json.dumps(header).encode(), dtype=np.uint8), **state)
-            with open(path, "wb") as f:
-                f.write(b"bs64\t")
-                f.write(base64.b64encode(buf.getvalue()))
-                f.write(b"\n")
+            payload = b"bs64\t" + base64.b64encode(buf.getvalue()) + b"\n"
+            if path == "stdout":
+                sys.stdout.buffer.write(payload)
+                sys.stdout.buffer.flush()
+            else:
+                with open(path, "wb") as f:
+                    f.write(payload)
             return
         with open(path, "wb") as f:
             np.savez(f, header=np.frombuffer(
@@ -635,7 +678,10 @@ def train(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
         bst.update(dtrain, i, fobj=obj)
         if not evals:
             continue
-        msg = bst.eval_set(evals, i, feval)
+        from contextlib import nullcontext
+        prof = bst.profiler
+        with prof.phase("eval") if prof else nullcontext():
+            msg = bst.eval_set(evals, i, feval)  # folds into ended round
         if verbose_eval:
             print(msg)
         scores = _parse_eval(msg)
@@ -660,6 +706,9 @@ def train(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
     if early_stopping_rounds is not None and best_score is not None:
         bst.best_score = best_score
         bst.best_iteration = best_iter
+    if getattr(bst, "_profiler", None) is not None:
+        bst._profiler.print_summary()
+        bst._profiler.stop()
     return bst
 
 

@@ -131,7 +131,10 @@ def precision_ratio(preds, labels, weights, group_ptr=None,
     (evaluation-inl.hpp:340) — which only coincides with instance weights
     when all weights are equal.  We weight the selected instance itself.
     """
-    preds = preds.ravel()
+    # like the reference, only the first prediction set is ranked
+    # (evaluation-inl.hpp:317-320 builds rec over labels.size() entries)
+    n = len(labels)
+    preds = preds[:, 0] if preds.ndim > 1 else preds.ravel()[:n]
     order = np.argsort(-preds, kind="stable")
     cutoff = int(ratio * len(preds))
     if cutoff == 0:

@@ -1,0 +1,124 @@
+"""Per-round timing breakdown + jax.profiler trace capture.
+
+The analog of the reference's ``report_stats`` accounting
+(``subtree/rabit/src/allreduce_mock.h:52-56,87-95``: per-version
+allreduce time and checkpoint cost) and of SURVEY.md §5.1's "keep the
+report_stats idea".  Two levels:
+
+- ``profile=1`` — host-side phase timing per boosting round (predict /
+  gradient / grow / eval), printed per round and summarized at the end.
+  Phases force ``block_until_ready`` at their boundaries so async
+  dispatch doesn't smear costs across phases (small overhead; off by
+  default).
+- ``profile=2`` — additionally captures a ``jax.profiler`` trace into
+  ``profile_dir`` (default ``./xgtpu_profile``) for XProf/TensorBoard —
+  the device-side view of kernel time.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Optional
+
+
+class RoundProfiler:
+    """Collects per-phase wall time per boosting round."""
+
+    def __init__(self, level: int = 1, trace_dir: Optional[str] = None,
+                 out=None):
+        import sys
+        self.level = level
+        self.trace_dir = trace_dir or "./xgtpu_profile"
+        self.out = out if out is not None else sys.stderr
+        self.rounds = []
+        self._current = None
+        self._tracing = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self.level >= 2 and not self._tracing:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+
+    def stop(self):
+        if self._tracing:
+            import jax
+            jax.profiler.stop_trace()
+            self._tracing = False
+            print(f"[prof] jax.profiler trace written to {self.trace_dir}",
+                  file=self.out)
+
+    # ---------------------------------------------------------- round phases
+    def begin_round(self, iteration: int):
+        self._current = {"round": iteration, "phases": {}, "t0": None}
+
+    def phase(self, name: str):
+        """Context manager timing one phase of the current round.  Call
+        ``.block(x)`` inside (or rely on the caller's own sync) to pin
+        async device work to this phase."""
+        return _Phase(self, name)
+
+    def end_round(self):
+        if self._current is None:
+            return
+        c = self._current
+        total = sum(c["phases"].values())
+        parts = " ".join(f"{k}={v * 1e3:.1f}ms"
+                         for k, v in c["phases"].items())
+        print(f"[prof] round {c['round']}: total={total * 1e3:.1f}ms "
+              f"{parts}", file=self.out)
+        self.rounds.append(c)
+        self._current = None
+
+    # ------------------------------------------------------------- summary
+    def summary(self) -> str:
+        if not self.rounds:
+            return "[prof] no rounds recorded"
+        agg = defaultdict(float)
+        for r in self.rounds:
+            for k, v in r["phases"].items():
+                agg[k] += v
+        total = sum(agg.values())
+        n = len(self.rounds)
+        lines = [f"[prof] {n} rounds, {total:.3f}s total, "
+                 f"{total / n * 1e3:.1f}ms/round"]
+        for k, v in sorted(agg.items(), key=lambda kv: -kv[1]):
+            lines.append(f"[prof]   {k:<10s} {v:8.3f}s  "
+                         f"{v / total * 100:5.1f}%  {v / n * 1e3:8.1f}ms/round")
+        return "\n".join(lines)
+
+    def print_summary(self):
+        print(self.summary(), file=self.out)
+
+
+class _Phase:
+    def __init__(self, prof: RoundProfiler, name: str):
+        self.prof = prof
+        self.name = name
+        self._blocked = None
+
+    def block(self, x):
+        """Record device arrays whose completion closes this phase."""
+        self._blocked = x
+        return x
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._blocked is not None and exc[0] is None:
+            import jax
+            jax.block_until_ready(self._blocked)
+        cur = self.prof._current
+        if cur is None and self.prof.rounds:
+            # outside begin/end (e.g. eval after end_round): fold into
+            # the most recent round
+            cur = self.prof.rounds[-1]
+        if cur is not None:
+            cur["phases"][self.name] = (
+                cur["phases"].get(self.name, 0.0)
+                + time.perf_counter() - self.t0)
+        return False
