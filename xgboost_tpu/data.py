@@ -32,7 +32,7 @@ class MetaInfo:
     """Per-row (and per-group) metadata (reference src/learner/dmatrix.h:18-145)."""
 
     __slots__ = ("label", "weight", "group_ptr", "base_margin",
-                 "root_index", "fold_index")
+                 "root_index", "fold_index", "_dev_cache")
 
     def __init__(self):
         self.label: Optional[np.ndarray] = None
@@ -41,13 +41,40 @@ class MetaInfo:
         self.base_margin: Optional[np.ndarray] = None
         self.root_index: Optional[np.ndarray] = None
         self.fold_index: Optional[np.ndarray] = None
+        # device copies + validation marks, reused across boosting rounds
+        # (re-uploading label/weight every round costs more host<->device
+        # time than the gradient computation itself)
+        self._dev_cache: dict = {}
 
     def get_weight(self, n_rows: int) -> np.ndarray:
         if self.weight is None:
             return np.ones(n_rows, dtype=np.float32)
         return self.weight
 
+    def label_dev(self):
+        """Device-resident label, cached until the field changes."""
+        if "label" not in self._dev_cache:
+            import jax.numpy as jnp
+            self._dev_cache["label"] = jnp.asarray(self.label)
+        return self._dev_cache["label"]
+
+    def weight_dev(self, n_rows: int):
+        """Device-resident per-row weight (ones when unset), cached."""
+        key = ("weight", n_rows)
+        if key not in self._dev_cache:
+            import jax.numpy as jnp
+            self._dev_cache[key] = jnp.asarray(self.get_weight(n_rows))
+        return self._dev_cache[key]
+
+    def check_once(self, mark: str, fn) -> None:
+        """Run a host-side validation once per (info, mark); cleared when
+        any field is re-set."""
+        if mark not in self._dev_cache:
+            fn()
+            self._dev_cache[mark] = True
+
     def set_field(self, name: str, value) -> None:
+        self._dev_cache.clear()
         if value is None:
             setattr(self, name if name != "group" else "group_ptr", None)
             return
@@ -162,13 +189,16 @@ class DMatrix:
         self.info.set_field("base_margin", margin)
 
     def get_label(self):
-        return self.info.label
+        # a copy: in-place mutation of the returned array would bypass
+        # MetaInfo's device-cache invalidation (set via set_field only)
+        return None if self.info.label is None else self.info.label.copy()
 
     def get_weight(self):
-        return self.info.get_weight(self.num_row)
+        return self.info.get_weight(self.num_row).copy()
 
     def get_base_margin(self):
-        return self.info.base_margin
+        return (None if self.info.base_margin is None
+                else self.info.base_margin.copy())
 
     # ------------------------------------------------------------------
     def column_values(self, col: int):

@@ -131,10 +131,11 @@ def precision_ratio(preds, labels, weights, group_ptr=None,
     (evaluation-inl.hpp:340) — which only coincides with instance weights
     when all weights are equal.  We weight the selected instance itself.
     """
-    # like the reference, only the first prediction set is ranked
-    # (evaluation-inl.hpp:317-320 builds rec over labels.size() entries)
+    # like the reference, only the first labels.size() entries of the FLAT
+    # (row-major) prediction vector are ranked (evaluation-inl.hpp:317-320
+    # over preds laid out preds[row*ngroup+group], gbtree-inl.hpp:157)
     n = len(labels)
-    preds = preds[:, 0] if preds.ndim > 1 else preds.ravel()[:n]
+    preds = np.asarray(preds).ravel()[:n]
     order = np.argsort(-preds, kind="stable")
     cutoff = int(ratio * len(preds))
     if cutoff == 0:

@@ -22,7 +22,8 @@ from typing import Callable, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from xgboost_tpu.ops.histogram import build_level_histogram, node_stats
+from xgboost_tpu.ops.histogram import (build_level_histogram, node_stats,
+                                       stats_from_histogram)
 from xgboost_tpu.ops.split import SplitConfig, calc_weight, find_best_splits
 
 
@@ -154,15 +155,18 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
     for depth in range(D + 1):
         n_node = 1 << depth
         base = n_node - 1  # global index of first node at this level
-        nst = red(node_stats(gh_used, pos, n_node))  # (n_node, 2)
 
         if depth == D:
             # terminal level: everything still active becomes a leaf
+            nst = red(node_stats(gh_used, pos, n_node))  # (n_node, 2)
             make_leaf = jnp.ones(n_node, jnp.bool_)
             best = None
         else:
             hist = red(build_level_histogram(binned, gh_used, pos,
                                              n_node, cfg.n_bin))
+            # node totals fall out of the histogram (bin sums of any one
+            # feature) — saves a per-level pass over all rows
+            nst = stats_from_histogram(hist)
             fmask = feat_mask_tree
             if cfg.colsample_bylevel < 1.0:
                 fmask = fmask & feat_sampler(

@@ -87,14 +87,15 @@ class RegLossObj(Objective):
             self.scale_pos_weight = float(value)
 
     def get_gradient(self, margin, info, iteration, n_rows):
-        label = jnp.asarray(info.label)
         if self.loss != "linear":
-            lab = np.asarray(info.label)
-            if ((lab < 0) | (lab > 1)).any():
-                raise ValueError(
-                    "label must be in [0,1] for logistic regression")
-        weight = jnp.asarray(info.get_weight(n_rows))
-        return _regloss_grad(margin, label, weight, self.loss,
+            def _check():
+                lab = np.asarray(info.label)
+                if ((lab < 0) | (lab > 1)).any():
+                    raise ValueError(
+                        "label must be in [0,1] for logistic regression")
+            info.check_once("logistic_label_ok", _check)
+        return _regloss_grad(margin, info.label_dev(),
+                             info.weight_dev(n_rows), self.loss,
                              float(self.scale_pos_weight))
 
     def pred_transform(self, margin, output_margin=False):
@@ -144,13 +145,14 @@ class SoftmaxMultiClassObj(Objective):
 
     def get_gradient(self, margin, info, iteration, n_rows):
         assert self.nclass > 0, "must set num_class to use softmax"
-        lab = np.asarray(info.label)
-        if ((lab < 0) | (lab >= self.nclass)).any():
-            raise ValueError(
-                f"SoftmaxMultiClassObj: label must be in [0, {self.nclass})")
-        label = jnp.asarray(info.label)
-        weight = jnp.asarray(info.get_weight(n_rows))
-        return _softmax_grad(margin, label, weight)
+        def _check():
+            lab = np.asarray(info.label)
+            if ((lab < 0) | (lab >= self.nclass)).any():
+                raise ValueError(
+                    f"SoftmaxMultiClassObj: label must be in [0, {self.nclass})")
+        info.check_once("softmax_label_ok", _check)
+        return _softmax_grad(margin, info.label_dev(),
+                             info.weight_dev(n_rows))
 
     def pred_transform(self, margin, output_margin=False):
         if output_margin:

@@ -1,0 +1,189 @@
+"""Pallas TPU histogram kernel — the one custom kernel in the framework.
+
+Replaces the reference's per-thread histogram accumulation
+(``src/tree/updater_histmaker-inl.hpp:296-348``) for the hot path.  A
+scatter-add over (node, feature, bin) cells serializes on TPU; this
+kernel reformulates the histogram as MXU matmuls:
+
+  For a row tile of R rows and one feature f:
+      onehot[b, r]   = 1 iff binned[f, r] == b               (B, R)
+      gh_exp[r, l]   = gh[r, l // M] * (pos[r] == l % M)     (R, 2M)
+      hist_f        += onehot @ gh_exp                       (B, 2M)
+
+  i.e. the per-node gradient/hessian sums of every bin fall out of a
+  single (B x R) @ (R x 2M) matmul with the level's M nodes (and the
+  grad/hess channel) packed into the MXU lane dimension.  At the deepest
+  default level (depth 6, M = 64) the lane dim is exactly 128 — a full
+  MXU pass.  Inactive rows (pos < 0, i.e. parked / padding /
+  subsampled-out shards) contribute nothing because the node mask never
+  matches.
+
+Bins are consumed feature-major ((F, N), int32) so every block satisfies
+the TPU (8, 128) tile rule; the (N, F) -> (F, N) transpose happens once
+per jit trace (CSE collapses the per-level copies inside one tree).
+
+Grid: (feature_tiles, row_tiles), row tiles innermost so each feature
+tile's output block accumulates across row tiles in VMEM.
+
+The XLA scatter in :mod:`xgboost_tpu.ops.histogram` remains the portable
+fallback (CPU mesh tests, interpret-free debugging).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
+                 n_bin: int, m_pad: int, f_tile: int, precision_mode: str):
+    """One (feature_tile, row_tile) grid step.
+
+    binned_ref: (f_tile, R) int32 bin ids, feature-major
+    pos_ref:    (R, 1) int32 node position (-1 = inactive)
+    gh_ref:     (R, 2) f32 grad/hess
+    out_ref:    (f_tile * n_bin, 2 * m_pad) f32 accumulator
+    """
+    r_tile = binned_ref.shape[1]
+    m2 = 2 * m_pad
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    pos = pos_ref[:, 0]
+    # gh_exp[r, l] = gh[r, l // m_pad] masked by (pos[r] == l % m_pad);
+    # built with broadcast selects (no lane concat, no relayout).
+    lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, m2), 1)
+    node_of_lane = jnp.where(lane < m_pad, lane, lane - m_pad)
+    g = gh_ref[:, 0:1]
+    h = gh_ref[:, 1:2]
+    ghsel = jnp.where(lane < m_pad, g, h)                    # (R, 2M)
+    active = (pos[:, None] == node_of_lane)                  # (R, 2M)
+    gh_exp = jnp.where(active, ghsel, 0.0)
+
+    # TPU matmul default precision truncates f32 operands to bf16; fp32
+    # mode must request HIGHEST for exact (parity-testable) histograms.
+    prec = (jax.lax.Precision.HIGHEST if precision_mode == "fp32"
+            else jax.lax.Precision.DEFAULT)  # HIGH: unsupported by Mosaic
+    bins = binned_ref[:]                                     # (f_tile, R)
+    bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_bin, r_tile), 0)
+    for f in range(f_tile):
+        onehot = (bins[f:f + 1, :] == bin_ids).astype(jnp.float32)  # (B, R)
+        acc = jax.lax.dot_general(
+            onehot, gh_exp, (((1,), (0,)), ((), ())),
+            precision=prec,
+            preferred_element_type=jnp.float32)              # (B, 2M)
+        out_ref[f * n_bin:(f + 1) * n_bin, :] += acc
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_node", "n_bin", "precision", "interpret"))
+def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
+                                 pos: jax.Array, n_node: int, n_bin: int,
+                                 precision: str = "fp32",
+                                 interpret: bool = False) -> jax.Array:
+    """Pallas drop-in for ``histogram.build_level_histogram``.
+
+    Args match the XLA version; ``precision`` selects the MXU pass count:
+    "fp32" (HIGHEST, exact f32 — parity-testable against the scatter) or
+    "bf16" (DEFAULT, ~3x faster; operands truncated to bf16 inside the
+    MXU, accumulation still f32).
+
+    Returns (n_node, F, n_bin, 2) float32.
+    """
+    N, F = binned.shape
+    r_tile = int(os.environ.get("XGBTPU_HIST_RTILE", "1024"))
+    # feature tile sized so the output block (f_tile*B, 2M) f32 stays
+    # ~<=1MB of VMEM at any depth (2M lanes grow with the level)
+    f_tile = max(1, min(F, (256 * 1024) // (max(n_bin, 1) *
+                                            max(2 * n_node, 128))))
+    n_pad = _round_up(max(N, 1), r_tile)
+    f_pad = _round_up(F, f_tile)
+    m_pad = n_node  # lanes pad to 128 inside the MXU anyway
+
+    binned_t = binned.astype(jnp.int32).T                    # (F, N)
+    if n_pad != N or f_pad != F:
+        binned_t = jnp.pad(binned_t, ((0, f_pad - F), (0, n_pad - N)))
+        gh = jnp.pad(gh, ((0, n_pad - N), (0, 0)))
+        pos = jnp.pad(pos, (0, n_pad - N), constant_values=-1)
+
+    kernel = functools.partial(_hist_kernel, n_bin=n_bin, m_pad=m_pad,
+                               f_tile=f_tile, precision_mode=precision)
+    out = pl.pallas_call(
+        kernel,
+        grid=(f_pad // f_tile, n_pad // r_tile),
+        in_specs=[
+            pl.BlockSpec((f_tile, r_tile), lambda fi, ri: (fi, ri)),
+            pl.BlockSpec((r_tile, 1), lambda fi, ri: (ri, 0)),
+            pl.BlockSpec((r_tile, 2), lambda fi, ri: (ri, 0)),
+        ],
+        out_specs=pl.BlockSpec((f_tile * n_bin, 2 * m_pad),
+                               lambda fi, ri: (fi, 0)),
+        out_shape=jax.ShapeDtypeStruct((f_pad * n_bin, 2 * m_pad),
+                                       jnp.float32),
+        interpret=interpret,
+    )(binned_t, pos.reshape(-1, 1).astype(jnp.int32),
+      gh.astype(jnp.float32))
+
+    # (f_pad*B, 2M) -> (F, B, 2, M) -> (M, F, B, 2)
+    out = out.reshape(f_pad, n_bin, 2, m_pad)
+    return out.transpose(3, 0, 1, 2)[:, :F, :, :]
+
+
+def _nst_kernel(pos_ref, gh_ref, out_ref, *, m_pad: int):
+    """Per-node (G, H) sums for one row tile: ones @ gh_exp on the MXU."""
+    r_tile = pos_ref.shape[0]
+    m2 = 2 * m_pad
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    pos = pos_ref[:, 0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, m2), 1)
+    node_of_lane = jnp.where(lane < m_pad, lane, lane - m_pad)
+    ghsel = jnp.where(lane < m_pad, gh_ref[:, 0:1], gh_ref[:, 1:2])
+    gh_exp = jnp.where(pos[:, None] == node_of_lane, ghsel, 0.0)
+    ones = jnp.ones((8, r_tile), jnp.float32)
+    out_ref[:] += jax.lax.dot_general(
+        ones, gh_exp, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_node", "interpret"))
+def node_stats_pallas(gh: jax.Array, pos: jax.Array, n_node: int,
+                      interpret: bool = False) -> jax.Array:
+    """Pallas drop-in for ``histogram.node_stats``: (n_node, 2) f32.
+
+    Exact (HIGHEST-precision dot against a ones matrix — sums of f32
+    values, bit-comparable to the scatter up to addition order).
+    """
+    N = gh.shape[0]
+    r_tile = 2048
+    n_pad = _round_up(max(N, 1), r_tile)
+    if n_pad != N:
+        gh = jnp.pad(gh, ((0, n_pad - N), (0, 0)))
+        pos = jnp.pad(pos, (0, n_pad - N), constant_values=-1)
+    kernel = functools.partial(_nst_kernel, m_pad=n_node)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // r_tile,),
+        in_specs=[
+            pl.BlockSpec((r_tile, 1), lambda ri: (ri, 0)),
+            pl.BlockSpec((r_tile, 2), lambda ri: (ri, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, 2 * n_node), lambda ri: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 2 * n_node), jnp.float32),
+        interpret=interpret,
+    )(pos.reshape(-1, 1).astype(jnp.int32), gh.astype(jnp.float32))
+    return out[0].reshape(2, n_node).T  # (n_node, 2)
