@@ -194,7 +194,9 @@ class DMatrix:
         return None if self.info.label is None else self.info.label.copy()
 
     def get_weight(self):
-        return self.info.get_weight(self.num_row).copy()
+        w = self.info.get_weight(self.num_row)
+        # copy only stored arrays: the unset case is already a fresh ones()
+        return w.copy() if self.info.weight is not None else w
 
     def get_base_margin(self):
         return (None if self.info.base_margin is None

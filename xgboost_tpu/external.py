@@ -34,7 +34,7 @@ import numpy as np
 
 from xgboost_tpu.data import DMatrix, MetaInfo, load_meta_sidecars
 from xgboost_tpu.models.tree import (GrowConfig, TreeArrays, _traverse_one,
-                                     apply_level, empty_tree)
+                                     apply_level, bin_of_feature, empty_tree)
 from xgboost_tpu.ops.histogram import build_level_histogram, node_stats
 from xgboost_tpu.ops.split import find_best_splits
 from xgboost_tpu.sketch import (QuantileSummary, empty_summary, make_summary,
@@ -274,8 +274,7 @@ def _paged_level_hist(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
     for _ in range(depth):
         f = tree.feature[node]
         at_leaf = tree.is_leaf[node] | (f < 0)
-        b = jnp.take_along_axis(binned.astype(jnp.int32),
-                                jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        b = bin_of_feature(binned, jnp.maximum(f, 0))
         go_left = jnp.where(b == 0, tree.default_left[node],
                             b <= tree.cut_index[node] + 1)
         nxt = jnp.where(go_left, 2 * node + 1, 2 * node + 2)

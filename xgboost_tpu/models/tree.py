@@ -75,13 +75,24 @@ def _default_split_finder(hist, nst, n_cuts, cut_values, fmask, split_cfg):
                          jnp.zeros_like(best.feature))
 
 
+def bin_of_feature(binned: jax.Array, f_row: jax.Array) -> jax.Array:
+    """Per-row bin id of a per-row feature: ``binned[r, f_row[r]]``.
+
+    Selected with a broadcast compare + masked sum over (N, F) instead of
+    ``take_along_axis``: dynamic lane gathers serialize on TPU (~16 ms per
+    level at 1M x 28) while this is a fused VPU pass (~1 ms).  Out-of-range
+    ``f_row`` yields bin 0 (missing)."""
+    f_ids = jnp.arange(binned.shape[1], dtype=jnp.int32)
+    sel = f_ids[None, :] == f_row[:, None]               # (N, F)
+    return jnp.where(sel, binned.astype(jnp.int32), 0).sum(axis=1)
+
+
 def _default_router(best: SplitDecision, node_of_row, binned):
     """Row go-left decision when the split feature's bins are local."""
     f_row = best.feature[node_of_row]
     j_row = best.cut_index[node_of_row]
     dl_row = best.default_left[node_of_row]
-    b = jnp.take_along_axis(binned.astype(jnp.int32),
-                            f_row[:, None], axis=1)[:, 0]
+    b = bin_of_feature(binned, f_row)
     return jnp.where(b == 0, dl_row, b <= j_row + 1)
 
 
@@ -269,8 +280,7 @@ def _traverse_one(tree: TreeArrays, binned: jax.Array, max_depth: int):
     for _ in range(max_depth):
         f = tree.feature[node]
         leaf = tree.is_leaf[node] | (f < 0)
-        b = jnp.take_along_axis(binned.astype(jnp.int32),
-                                jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        b = bin_of_feature(binned, jnp.maximum(f, 0))
         go_left = jnp.where(b == 0, tree.default_left[node],
                             b <= tree.cut_index[node] + 1)
         nxt = jnp.where(go_left, 2 * node + 1, 2 * node + 2)

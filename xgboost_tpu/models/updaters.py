@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from xgboost_tpu.models.tree import TreeArrays
+from xgboost_tpu.models.tree import TreeArrays, bin_of_feature
 from xgboost_tpu.ops.split import SplitConfig, calc_gain, calc_weight
 
 KNOWN_UPDATERS = ("grow_colmaker", "grow_histmaker", "grow_skmaker",
@@ -123,8 +123,7 @@ def refresh_tree(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
         acc = acc.at[node].add(gh_used)
         f = tree.feature[node]
         leaf = tree.is_leaf[node] | (f < 0)
-        b = jnp.take_along_axis(binned.astype(jnp.int32),
-                                jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        b = bin_of_feature(binned, jnp.maximum(f, 0))
         go_left = jnp.where(b == 0, tree.default_left[node],
                             b <= tree.cut_index[node] + 1)
         node = jnp.where(leaf, node, jnp.where(go_left, 2 * node + 1,

@@ -101,6 +101,8 @@ def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
     Returns (n_node, F, n_bin, 2) float32.
     """
     N, F = binned.shape
+    # read at trace time: changing it after the first same-shape call has
+    # no effect (jit cache) — set it before the first training round
     r_tile = int(os.environ.get("XGBTPU_HIST_RTILE", "1024"))
     # feature tile sized so the output block (f_tile*B, 2M) f32 stays
     # ~<=1MB of VMEM at any depth (2M lanes grow with the level)

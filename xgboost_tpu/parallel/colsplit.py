@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from xgboost_tpu.models.tree import (GrowConfig, SplitDecision,
-                                     _sample_features, grow_tree)
+                                     _sample_features, bin_of_feature,
+                                     grow_tree)
 from xgboost_tpu.ops.split import NEG, RT_EPS, find_best_splits
 
 FEAT_AXIS = "feat"
@@ -139,9 +140,7 @@ def _colsplit_router(best: SplitDecision, node_of_row, binned, *,
     owner_row = best.owner[node_of_row]
     lf_row = best.feature[node_of_row] - owner_row * f_local
     i_own = owner_row == shard
-    b = jnp.take_along_axis(
-        binned.astype(jnp.int32),
-        jnp.clip(lf_row, 0, binned.shape[1] - 1)[:, None], axis=1)[:, 0]
+    b = bin_of_feature(binned, jnp.clip(lf_row, 0, binned.shape[1] - 1))
     dl_row = best.default_left[node_of_row]
     j_row = best.cut_index[node_of_row]
     go_left_local = jnp.where(b == 0, dl_row, b <= j_row + 1)
