@@ -20,3 +20,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    """The 8-virtual-device data-parallel mesh."""
+    from xgboost_tpu.parallel.mesh import data_parallel_mesh
+    return data_parallel_mesh(8)

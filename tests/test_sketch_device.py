@@ -1,0 +1,134 @@
+"""Device-side distributed sketch (parallel/sketch_device.py).
+
+Validates the fixed-shape padded summary against the host sketch
+(:mod:`xgboost_tpu.sketch`): WQSummary invariants (reference
+``quantile.h:165-173``), the rank-error bound of merge+prune, cut
+proposal rank-parity under mesh sharding, determinism, and end-to-end
+dsplit=row training with ``device_sketch=1``.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from xgboost_tpu.parallel.sketch_device import (  # noqa: E402
+    local_summary, merge_summaries_dev, propose_cuts_dev, sketch_cuts_mesh)
+from xgboost_tpu.sketch import (make_summary, merge_summaries,  # noqa: E402
+                                propose_cuts, prune_summary)
+
+
+def _invariants(s, eps=1e-2):
+    v = np.asarray(s.value)
+    real = np.isfinite(v)
+    v, rmin, rmax, wmin = (np.asarray(x)[real] for x in
+                           (s.value, s.rmin, s.rmax, s.wmin))
+    assert (rmin + wmin <= rmax + eps).all(), "rmin+wmin > rmax"
+    assert (rmin >= -eps).all() and (wmin >= -eps).all()
+    assert (np.diff(v) > 0).all(), "values not strictly increasing"
+    assert (np.diff(rmin) >= -eps).all() and (np.diff(rmax) >= -eps).all()
+    return v, rmin, rmax, wmin
+
+
+def _max_rank_err(rmin, rmax, wmin):
+    prev_rmax = np.concatenate([[0.0], rmax[:-1]])
+    return float(np.maximum(rmin + wmin - prev_rmax,
+                            rmax - rmin - wmin).max())
+
+
+def test_local_summary_invariants_and_bound():
+    rng = np.random.RandomState(0)
+    v = rng.exponential(1.0, 5000).astype(np.float32)
+    K = 64
+    s = local_summary(jnp.asarray(v), jnp.ones(5000), K)
+    _, rmin, rmax, wmin = _invariants(s)
+    assert abs(float(s.rmax[-1]) - 5000) < 0.5
+    # prune at size K keeps error <= ~2*total/K (WQSummary::SetPrune bound)
+    assert _max_rank_err(rmin, rmax, wmin) <= 2.5 * 5000 / K
+
+
+def test_local_summary_missing_and_duplicates():
+    v = np.array([1.0, np.nan, 2.0, 1.0, np.inf, 2.0, 3.0], np.float32)
+    s = local_summary(jnp.asarray(v), jnp.ones(7), 16)
+    vals, rmin, rmax, wmin = _invariants(s)
+    np.testing.assert_array_equal(vals, [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(wmin, [2.0, 2.0, 1.0])
+    assert float(s.rmax[-1]) == 5.0  # nan/inf rows dropped
+
+
+def test_merge_matches_host_semantics():
+    rng = np.random.RandomState(1)
+    a = rng.randn(3000).astype(np.float32)
+    b = (rng.randn(3000) * 2 + 1).astype(np.float32)
+    K = 64
+    da = local_summary(jnp.asarray(a), jnp.ones(3000), K)
+    db = local_summary(jnp.asarray(b), jnp.ones(3000), K)
+    dm = merge_summaries_dev(da, db, K)
+    _, rmin, rmax, wmin = _invariants(dm)
+    assert abs(float(dm.rmax[-1]) - 6000) < 1.0
+    assert _max_rank_err(rmin, rmax, wmin) <= 3.0 * 6000 / K
+    # cuts from the device merge rank-match the host merge within eps
+    cuts_d = np.asarray(propose_cuts_dev(dm, 32))
+    cuts_d = cuts_d[np.isfinite(cuts_d)]
+    hm = prune_summary(merge_summaries(
+        prune_summary(make_summary(a), K), prune_summary(make_summary(b), K)),
+        K)
+    cuts_h = propose_cuts(hm, 32)
+    xs = np.sort(np.concatenate([a, b]))
+    rd = np.searchsorted(xs, cuts_d) / 6000
+    rh = np.searchsorted(xs, cuts_h) / 6000
+    m = min(len(rd), len(rh))
+    assert m >= 25
+    assert np.abs(rd[:m] - rh[:m]).max() <= 2.0 / K + 0.02
+
+
+def test_mesh_sketch_cuts_rank_parity(mesh8):
+    from xgboost_tpu.binning import compute_cuts
+    from xgboost_tpu.data import DMatrix
+    rng = np.random.RandomState(0)
+    X = rng.exponential(1.0, (20000, 5)).astype(np.float32)
+    X[:, 2] = (X[:, 2] > 1.0)  # near-binary feature -> dense cut path
+    eps = 0.05
+    cuts_dev = sketch_cuts_mesh(mesh8, X, None, max_bin=32, sketch_eps=eps)
+    cuts_host = compute_cuts(DMatrix(X), max_bin=32, sketch_eps=eps)
+    for f in range(5):
+        cd = cuts_dev.cut_values[f][:cuts_dev.n_cuts[f]]
+        ch = cuts_host.cut_values[f][:cuts_host.n_cuts[f]]
+        xs = np.sort(X[:, f])
+        m = min(len(cd), len(ch))
+        assert m >= min(len(ch), 2)
+        rd = np.searchsorted(xs, cd[:m]) / len(xs)
+        rh = np.searchsorted(xs, ch[:m]) / len(xs)
+        assert np.abs(rd - rh).max() <= eps, f"feature {f}"
+    # binary feature: exact dense cuts
+    np.testing.assert_array_equal(
+        cuts_dev.cut_values[2][:cuts_dev.n_cuts[2]], [0.0, 1.0])
+
+
+def test_mesh_sketch_deterministic(mesh8):
+    rng = np.random.RandomState(3)
+    X = rng.randn(4096, 3).astype(np.float32)
+    c1 = sketch_cuts_mesh(mesh8, X, None, max_bin=16)
+    c2 = sketch_cuts_mesh(mesh8, X, None, max_bin=16)
+    np.testing.assert_array_equal(c1.cut_values, c2.cut_values)
+    np.testing.assert_array_equal(c1.n_cuts, c2.n_cuts)
+
+
+def test_train_with_device_sketch(mesh8):
+    import xgboost_tpu as xgb
+    rng = np.random.RandomState(0)
+    X = rng.rand(2000, 8).astype(np.float32)
+    y = ((X[:, 0] + 0.5 * X[:, 1] > 0.75)).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.5,
+              "dsplit": "row", "max_bin": 32}
+    ev_host, ev_dev = {}, {}
+    xgb.train(params, xgb.DMatrix(X, label=y), 5,
+              evals=[(xgb.DMatrix(X, label=y), "train")],
+              evals_result=ev_host, verbose_eval=False)
+    xgb.train({**params, "device_sketch": 1}, xgb.DMatrix(X, label=y), 5,
+              evals=[(xgb.DMatrix(X, label=y), "train")],
+              evals_result=ev_dev, verbose_eval=False)
+    eh = float(ev_host["train-error"][-1])
+    ed = float(ev_dev["train-error"][-1])
+    assert ed <= eh + 0.02, (eh, ed)

@@ -54,6 +54,10 @@ class TrainParam:
     # TPU-native binning: number of histogram bins (incl. reserved missing
     # bin 0).  The reference's analog is max_sketch_size=sketch_ratio/sketch_eps.
     max_bin: int = 256
+    # dsplit=row cut proposal on device: per-shard sketches merged over the
+    # mesh axis (parallel/sketch_device.py — rabit SerializeReducer analog,
+    # histmaker-inl.hpp:417-424).  0 = host-side global sketch.
+    device_sketch: int = 0
 
     # -- gbtree params (reference src/gbm/gbtree-inl.hpp:389-428) --
     num_parallel_tree: int = 1

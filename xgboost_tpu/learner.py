@@ -121,6 +121,20 @@ class Booster:
                     from xgboost_tpu.binning import compute_cuts_exact
                     cuts = compute_cuts_exact(dtrain,
                                               self.param.max_exact_bin)
+                elif self.param.device_sketch and self.param.dsplit == "row":
+                    # distributed cut proposal: per-shard device sketches
+                    # merged over the mesh axis — no host needs a full
+                    # column (SerializeReducer analog, SURVEY.md §5.8)
+                    from xgboost_tpu.parallel import mesh as pmesh
+                    from xgboost_tpu.parallel.sketch_device import \
+                        sketch_cuts_mesh
+                    if self._mesh is None:
+                        self._mesh = (pmesh.get_mesh()
+                                      or pmesh.data_parallel_mesh())
+                    cuts = sketch_cuts_mesh(
+                        self._mesh, dtrain.to_dense(), dtrain.info.weight,
+                        self.param.max_bin, self.param.sketch_eps,
+                        self.param.sketch_ratio)
                 else:
                     cuts = compute_cuts(dtrain, self.param.max_bin,
                                         self.param.sketch_eps,

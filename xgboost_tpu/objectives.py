@@ -150,7 +150,7 @@ class SoftmaxMultiClassObj(Objective):
             if ((lab < 0) | (lab >= self.nclass)).any():
                 raise ValueError(
                     f"SoftmaxMultiClassObj: label must be in [0, {self.nclass})")
-        info.check_once("softmax_label_ok", _check)
+        info.check_once(f"softmax_label_ok_{self.nclass}", _check)
         return _softmax_grad(margin, info.label_dev(),
                              info.weight_dev(n_rows))
 
