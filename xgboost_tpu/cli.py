@@ -52,6 +52,8 @@ class BoostLearnTask:
         self.name_dump = "dump.txt"
         self.checkpoint_dir: Optional[str] = None
         self.save_base64 = 0  # text-safe model files (reference bs64 mode)
+        self.mock_spec: List[Tuple[int, int, int]] = []  # fault injection
+        self.keepalive = 0  # restart-on-WorkerFailure (rabit_demo keepalive)
         self.eval_names: List[str] = []
         self.eval_paths: List[str] = []
         self.learner_params: List[Tuple[str, str]] = []
@@ -89,6 +91,21 @@ class BoostLearnTask:
             self.name_pred = val
         elif name == "checkpoint_dir":
             self.checkpoint_dir = val
+        elif name == "mock":
+            # reference AllreduceMock spec "rank,version,seqno,ntrial"
+            # (allreduce_mock.h:57-63); single-controller XLA training has
+            # no per-rank deaths, so a leading rank field is accepted and
+            # dropped.  Multiple coordinates: semicolon-separated.
+            for part in val.split(";"):
+                nums = [int(x) for x in part.split(",") if x.strip() != ""]
+                if len(nums) == 4:
+                    nums = nums[1:]
+                if len(nums) != 3:
+                    raise ValueError(
+                        f"mock={part!r}: expected version,seqno,ntrial")
+                self.mock_spec.append(tuple(nums))
+        elif name == "keepalive":
+            self.keepalive = int(val)
         else:
             m = re.match(r"eval\[([^\]]+)\]", name)
             if m:
@@ -119,7 +136,26 @@ class BoostLearnTask:
             self.save_period = 0
 
         if self.task == "train":
-            return self.task_train()
+            if not self.mock_spec:
+                return self.task_train()
+            # fault-injection mode: install the injector; with keepalive,
+            # restart from the checkpoint ring on simulated death (the
+            # rabit_demo.py:26-40 keepalive wrapper, in-process)
+            from xgboost_tpu.parallel import mock
+            trial = int(os.environ.get("XGBTPU_NUM_TRIAL", "0"))
+            while True:
+                mock.set_fault_injection(self.mock_spec, trial)
+                try:
+                    return self.task_train()
+                except mock.WorkerFailure as e:
+                    print(f"{e}; "  # message carries the [mock] tag
+                          + ("restarting" if self.keepalive else "dead"),
+                          file=sys.stderr)
+                    if not self.keepalive:
+                        raise
+                    trial += 1
+                finally:
+                    mock.clear_fault_injection()
         if self.task == "pred":
             return self.task_pred()
         if self.task == "eval":

@@ -294,13 +294,29 @@ def parse_libsvm(path: str, rank: int = 0, nparts: int = 1):
     return parse_libsvm_python(path, rank, nparts)
 
 
-def parse_libsvm_python(path: str, rank: int = 0, nparts: int = 1):
-    """Pure-Python libsvm parser (fallback + parity oracle for the
-    native parser's tests)."""
-    labels = []
-    indptr = [0]
+def iter_libsvm_chunks(path: str, chunk_rows: int, rank: int = 0,
+                       nparts: int = 1):
+    """Stream a libsvm text file as bounded CSR chunks.
+
+    Yields (indptr, indices, values, labels) per ``chunk_rows`` rows —
+    host memory stays at one chunk regardless of file size (the
+    reference's ThreadedParser streaming, ``src/io/libsvm_parser.h``).
+    Shared by the whole-file parser below and external-memory ingest.
+    """
+    labels: list = []
+    indptr: list = [0]
     indices: list = []
     values: list = []
+
+    def emit():
+        out = (np.asarray(indptr, dtype=np.int64),
+               np.asarray(indices, dtype=np.int32),
+               np.asarray(values, dtype=np.float32),
+               np.asarray(labels, dtype=np.float32))
+        labels.clear(), indices.clear(), values.clear()
+        indptr.clear(), indptr.append(0)
+        return out
+
     with open(path, "rb") as f:
         for i, raw in enumerate(f):
             if nparts > 1 and i % nparts != rank:
@@ -314,10 +330,20 @@ def parse_libsvm_python(path: str, rank: int = 0, nparts: int = 1):
                 indices.append(int(k))
                 values.append(float(v))
             indptr.append(len(indices))
-    return (np.asarray(indptr, dtype=np.int64),
-            np.asarray(indices, dtype=np.int32),
-            np.asarray(values, dtype=np.float32),
-            np.asarray(labels, dtype=np.float32))
+            if len(labels) >= chunk_rows:
+                yield emit()
+    if labels:
+        yield emit()
+
+
+def parse_libsvm_python(path: str, rank: int = 0, nparts: int = 1):
+    """Pure-Python libsvm parser (fallback + parity oracle for the
+    native parser's tests)."""
+    chunks = list(iter_libsvm_chunks(path, 1 << 62, rank, nparts))
+    if not chunks:
+        return (np.zeros(1, np.int64), np.zeros(0, np.int32),
+                np.zeros(0, np.float32), np.zeros(0, np.float32))
+    return chunks[0]
 
 
 def load_meta_sidecars(dmat: DMatrix, path: str) -> None:

@@ -91,15 +91,33 @@ class ExtMemDMatrix:
     def _pages_path(self) -> str:
         return self.cache_prefix + ".pages"
 
-    def _ingest_libsvm(self, path: str, missing: float, silent: bool):
-        from xgboost_tpu.data import parse_libsvm
-        # the parser is the native multithreaded one when available; rows
-        # then stream out to the page store so later passes are paged
-        indptr, indices, values, labels = parse_libsvm(path)
-        self._num_col = int(indices.max()) + 1 if len(indices) else 0
-        self.info.set_field("label", labels)
-        self._write_pages_from_csr(indptr, indices, values)
-        self._num_row = len(labels)
+    def _ingest_libsvm(self, path: str, missing: float, silent: bool,
+                       chunk_lines: int = 0):
+        """Stream-parse text into the page store chunk by chunk.
+
+        The reference never holds a whole text source in memory
+        (``libsvm_parser.h`` ThreadedParser streams chunks); parsing
+        bounded line blocks keeps host RAM at one chunk + one page, so
+        external memory relieves host RAM as well as HBM."""
+        from xgboost_tpu.data import iter_libsvm_chunks
+        chunk_lines = chunk_lines or self.page_rows
+        writer = self._page_writer()
+        all_labels: List[np.ndarray] = []
+        num_col = 0
+        n_rows = 0
+        for indptr, indices, values, labels in iter_libsvm_chunks(
+                path, chunk_lines):
+            self._push_page(writer, indptr, indices, values)
+            all_labels.append(labels)
+            if len(indices):
+                num_col = max(num_col, int(indices.max()) + 1)
+            n_rows += len(labels)
+        self._close_writer(writer)
+        self._num_col = num_col
+        self.info.set_field(
+            "label", np.concatenate(all_labels) if all_labels
+            else np.zeros(0, np.float32))
+        self._num_row = n_rows
 
     def _ingest_chunks(self, chunks: Iterator[Tuple[np.ndarray, np.ndarray]],
                        missing: float):
@@ -291,10 +309,38 @@ def _paged_leaf_delta(tree: TreeArrays, binned: jax.Array, max_depth: int):
     return tree.leaf_value[_traverse_one(tree, binned, max_depth)]
 
 
+@functools.partial(jax.jit, static_argnames=("depth", "n_bin", "mesh"))
+def _paged_level_hist_dp(mesh, tree: TreeArrays, binned: jax.Array,
+                         gh: jax.Array, depth: int, n_bin: int):
+    """Distributed batch histogram: rows of one streamed batch shard over
+    the mesh 'data' axis, partial histograms psum across shards (the
+    reference's paged matrices participating in dsplit=row training,
+    learner-inl.hpp:263-267 + histmaker's histred.Allreduce).
+
+    Padding rows carry gh == 0, so they contribute nothing to any cell.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def shard_fn(tree, binned, gh):
+        hist, nst = _paged_level_hist.__wrapped__(tree, binned, gh,
+                                                  depth, n_bin)
+        return (jax.lax.psum(hist, "data"), jax.lax.psum(nst, "data"))
+
+    fn = jax.shard_map(shard_fn, mesh=mesh,
+                       in_specs=(P(), P("data"), P("data")),
+                       out_specs=(P(), P()), check_vma=False)
+    return fn(tree, binned, gh)
+
+
 def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
                     cut_values: jax.Array, n_cuts: jax.Array,
-                    cfg: GrowConfig) -> TreeArrays:
+                    cfg: GrowConfig, mesh=None) -> TreeArrays:
     """Level-by-level growth streaming binned batches host→device.
+
+    With ``mesh``, each batch's rows shard over the 'data' axis and
+    partial histograms psum across shards before accumulating across
+    batches (distributed external memory: SURVEY.md §5.7 item 2 composed
+    with §2.4.2).
 
     gh: (N, 2) host gradients.  Row subsampling uses a host-side
     deterministic draw.  Returns the grown tree (delta is computed by the
@@ -319,9 +365,19 @@ def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
         hist = None
         nst = None
         for start, batch in dmat.binned_batches():
-            bgh = jnp.asarray(gh_used[start:start + batch.shape[0]])
-            h, s = _paged_level_hist(tree, jnp.asarray(batch), bgh, depth,
-                                     cfg.n_bin)
+            bgh = gh_used[start:start + batch.shape[0]]
+            if mesh is not None:
+                pad = (-batch.shape[0]) % mesh.devices.size
+                if pad:
+                    batch = np.pad(batch, ((0, pad), (0, 0)))
+                    bgh = np.concatenate(
+                        [bgh, np.zeros((pad, 2), np.float32)])
+                h, s = _paged_level_hist_dp(
+                    mesh, tree, jnp.asarray(batch), jnp.asarray(bgh),
+                    depth, cfg.n_bin)
+            else:
+                h, s = _paged_level_hist(tree, jnp.asarray(batch),
+                                         jnp.asarray(bgh), depth, cfg.n_bin)
             hist = h if hist is None else hist + h
             nst = s if nst is None else nst + s
         if depth == cfg.max_depth:

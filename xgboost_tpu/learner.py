@@ -199,10 +199,11 @@ class Booster:
 
     def _build_ext_entry(self, dmat) -> _CacheEntry:
         """Entry for an external-memory matrix (not necessarily cached)."""
-        if self._mesh is not None or self._col_mesh is not None:
+        if self._col_mesh is not None:
             raise NotImplementedError(
-                "external-memory matrices are single-chip for now "
-                "(dsplit=row/col unsupported)")
+                "external-memory matrices do not support dsplit=col "
+                "(the reference routes paged matrices to the histogram "
+                "row-split path too, learner-inl.hpp:263-267)")
         # (re)quantize when the matrix was binned with a DIFFERENT
         # model's cuts — reusing a stale memmap would silently compare
         # this model's cut indices against another model's bins
@@ -356,6 +357,11 @@ class Booster:
                        else self.gbtree.version)
 
     def _do_boost(self, dtrain, entry, gh, iteration):
+        # fault-injection seam (reference AllreduceMock, allreduce_mock.h:
+        # 37-44): every boosting round is a "version"; each collective
+        # launch inside it bumps the seqno (parallel/mock.py)
+        from xgboost_tpu.parallel import mock
+        mock.begin_round(iteration)
         # deterministic per-iteration seeding: the reference forces
         # seed_per_iteration in distributed mode for replayable recovery
         # (learner-inl.hpp:275-277); fold_in gives that always.
@@ -375,7 +381,7 @@ class Booster:
                     "updater=refresh is not supported on external-memory "
                     "matrices")
             deltas = self.gbtree.do_boost_paged(entry.dmat, np.asarray(gh),
-                                                key)
+                                                key, mesh=self._mesh)
             entry.margin += deltas
             entry.applied = self.gbtree.num_trees
             return
