@@ -267,10 +267,12 @@ def write_report(results: dict):
             f"{b['train_sec']:.0f} s -> "
             f"**{b['rows_per_sec_1thread']:,.0f} rows/s/thread**.",
             "",
-            "bench.py projects this to a 16-thread CPU with perfect "
-            "linear scaling (generous to the reference — real scaling is "
-            "sublinear) and uses `max(8e4, measured x 16)` as the "
-            "baseline denominator.",
+            "bench.py uses this rows/s/thread as the `vs_baseline` "
+            "denominator against our rows/s/chip: with 16 chips per "
+            "v5e-16 pod and 16 threads per CPU socket the factors "
+            "cancel, so the single-chip ratio equals the pod-vs-socket "
+            "wall-clock ratio under (generous) perfect-linear CPU "
+            "scaling.",
         ]
     lines += [
         "",
