@@ -1,0 +1,9 @@
+"""Alias: ``python -m xgboost_tpu.launch`` → the multi-host launcher
+(:mod:`xgboost_tpu.parallel.launch`)."""
+
+import sys
+
+from xgboost_tpu.parallel.launch import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,10 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-NEG = jnp.float32(-1e30)
+# plain float, not jnp: a module-level device constant would initialize
+# the XLA backend at import time, breaking jax.distributed.initialize()
+# (which must run first in multi-host workers — parallel/launch.py)
+NEG = -1e30
 RT_EPS = 1e-6  # reference rt_eps accept threshold
 
 

@@ -1,0 +1,176 @@
+"""Multi-host job launcher — the tracker/submitter analog.
+
+The reference's cluster layer is a Python rendezvous tracker plus
+submitters that spawn workers with rank/world env vars
+(``subtree/rabit/tracker/rabit_tracker.py:125-309``,
+``tracker/rabit_demo.py`` local multi-process with keepalive restart,
+``rabit_mpi/sge/yarn``).  Under JAX the tracker itself disappears — the
+JAX distributed runtime owns rendezvous — so what remains is exactly
+this launcher: assign (coordinator, num_processes, process_id), spawn,
+optionally restart dead workers (keepalive), and a worker-side
+``init_worker()`` that calls ``jax.distributed.initialize``.
+
+Local usage (the rabit_demo.py equivalent — N processes on one host):
+
+    python -m xgboost_tpu.launch -n 4 [--keepalive] \
+        python my_worker.py ...
+
+Cluster usage: run the same worker command on every host with
+``XGBTPU_COORD`` (host:port of process 0), ``XGBTPU_NUM_WORKER`` and
+``XGBTPU_WORKER_ID`` exported by the scheduler; ``init_worker()`` picks
+them up.  Workers load only their row shard (``parse_libsvm`` rank /
+nparts modulo split — reference ``simple_dmatrix-inl.hpp:89-96``) and
+assemble global arrays with ``jax.make_array_from_process_local_data``.
+
+What is multi-process capable today (tests/test_launch.py proves the
+2-process x 2-device path end to end): the launcher + ``init_worker``
+rendezvous, the global data-parallel mesh, and the distributed growth /
+sketch kernels (``parallel/dp.py``, ``parallel/sketch_device.py``).
+The high-level ``Booster`` convenience layer still assumes a single
+controller for metric evaluation and prediction pulls; a multi-process
+CLI training loop composes the pieces above the same way the worker in
+``tests/mp_grow_worker.py`` does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+COORD_ENV = "XGBTPU_COORD"
+NWORKER_ENV = "XGBTPU_NUM_WORKER"
+RANK_ENV = "XGBTPU_WORKER_ID"
+TRIAL_ENV = "XGBTPU_NUM_TRIAL"
+
+
+def init_worker(local_device_count: Optional[int] = None) -> bool:
+    """Initialize this process as a distributed JAX worker when the
+    launcher env is present.  Returns True iff distributed mode is on.
+
+    Call BEFORE any other jax API touches the backend.  After it,
+    ``jax.devices()`` spans all workers and
+    :func:`xgboost_tpu.parallel.mesh.data_parallel_mesh` builds the
+    global mesh (collectives ride ICI within a slice, DCN across).
+    """
+    coord = os.environ.get(COORD_ENV)
+    if not coord:
+        return False
+    n = int(os.environ[NWORKER_ENV])
+    rank = int(os.environ[RANK_ENV])
+    if local_device_count is None and os.environ.get("XGBTPU_LOCAL_DEVICES"):
+        local_device_count = int(os.environ["XGBTPU_LOCAL_DEVICES"])
+    if local_device_count is not None:
+        # CPU workers: give each process a fixed virtual device count
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{local_device_count}").strip()
+    import jax
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=n, process_id=rank)
+    return True
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _reap(procs: List[Optional[subprocess.Popen]]) -> None:
+    """Terminate-then-kill every live child and wait() them all (a worker
+    stuck in a collective can ignore SIGTERM)."""
+    for q in procs:
+        if q is not None and q.poll() is None:
+            q.terminate()
+    for q in procs:
+        if q is None:
+            continue
+        try:
+            q.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            q.kill()
+            q.wait()
+
+
+def launch_local(n: int, cmd: List[str], keepalive: bool = False,
+                 local_devices: Optional[int] = None,
+                 max_restarts: int = 10) -> int:
+    """Spawn ``n`` local worker processes running ``cmd`` (the
+    rabit_demo.py submitter).
+
+    With ``keepalive``, any nonzero worker death restarts the WHOLE gang
+    with a bumped trial counter and a fresh coordinator port: a single
+    restarted process cannot rejoin a live ``jax.distributed`` job, so
+    recovery is whole-job restart + resume from ``checkpoint_dir`` —
+    exactly the per-round-checkpoint fault model (SURVEY.md §5.3 TPU
+    mapping).  The fresh port per attempt also sidesteps the
+    free_port() probe/bind race.
+    """
+    trial = 0
+    while True:
+        coord = f"localhost:{free_port()}"
+
+        def spawn(rank: int) -> subprocess.Popen:
+            env = dict(os.environ)
+            env[COORD_ENV] = coord
+            env[NWORKER_ENV] = str(n)
+            env[RANK_ENV] = str(rank)
+            env[TRIAL_ENV] = str(trial)
+            if local_devices is not None:
+                env["XGBTPU_LOCAL_DEVICES"] = str(local_devices)
+            return subprocess.Popen(cmd, env=env)
+
+        procs: List[Optional[subprocess.Popen]] = [spawn(r)
+                                                   for r in range(n)]
+        failed_rc = None
+        while any(p is not None for p in procs) and failed_rc is None:
+            time.sleep(0.2)
+            for r, p in enumerate(procs):
+                if p is None or p.poll() is None:
+                    continue
+                if p.returncode == 0:
+                    procs[r] = None
+                else:
+                    failed_rc = p.returncode
+                    print(f"[launch] worker {r} died "
+                          f"(rc={p.returncode}, trial {trial})",
+                          file=sys.stderr)
+                    break
+        if failed_rc is None:
+            return 0
+        _reap(procs)
+        if not keepalive or trial >= max_restarts:
+            return failed_rc
+        trial += 1
+        print(f"[launch] restarting all {n} workers, trial {trial}",
+              file=sys.stderr)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m xgboost_tpu.launch",
+        description="spawn N distributed workers (rabit_demo.py analog)")
+    ap.add_argument("-n", "--nworker", type=int, required=True)
+    ap.add_argument("--keepalive", action="store_true",
+                    help="restart workers that die nonzero")
+    ap.add_argument("--local-devices", type=int, default=None,
+                    help="virtual CPU devices per worker (testing)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if args.cmd and args.cmd[0] == "--":
+        args.cmd = args.cmd[1:]
+    if not args.cmd:
+        ap.error("missing worker command")
+    return launch_local(args.nworker, args.cmd, keepalive=args.keepalive,
+                        local_devices=args.local_devices)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
