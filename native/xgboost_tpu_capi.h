@@ -1,0 +1,71 @@
+/*
+ * C ABI for xgboost_tpu — serves non-Python hosts (R, C/C++, JVM via
+ * JNI, ...).  The function surface mirrors the reference's C wrapper
+ * (reference wrapper/xgboost_wrapper.h:26-235) so existing bindings
+ * port by relinking; the implementation embeds the Python runtime and
+ * drives the JAX/TPU core through xgboost_tpu.capi_bridge.
+ *
+ * Memory contract (same as the reference): pointers returned by
+ * *GetFloatInfo / *GetUIntInfo / Predict / EvalOneIter / GetModelRaw /
+ * DumpModel stay valid until the next call of the same function on the
+ * same handle, or until the handle is freed.
+ *
+ * Errors print a Python traceback to stderr and abort the process
+ * (the reference's utils::Error behavior).
+ */
+#ifndef XGBOOST_TPU_CAPI_H_
+#define XGBOOST_TPU_CAPI_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned long xgt_ulong;
+
+/* ---- DMatrix ---- */
+void *XGDMatrixCreateFromFile(const char *fname, int silent);
+void *XGDMatrixCreateFromCSR(const xgt_ulong *indptr, const unsigned *indices,
+                             const float *data, xgt_ulong nindptr,
+                             xgt_ulong nelem);
+void *XGDMatrixCreateFromCSC(const xgt_ulong *col_ptr, const unsigned *indices,
+                             const float *data, xgt_ulong nindptr,
+                             xgt_ulong nelem);
+void *XGDMatrixCreateFromMat(const float *data, xgt_ulong nrow,
+                             xgt_ulong ncol, float missing);
+void *XGDMatrixSliceDMatrix(void *handle, const int *idxset, xgt_ulong len);
+void XGDMatrixFree(void *handle);
+void XGDMatrixSaveBinary(void *handle, const char *fname, int silent);
+void XGDMatrixSetFloatInfo(void *handle, const char *field,
+                           const float *array, xgt_ulong len);
+void XGDMatrixSetUIntInfo(void *handle, const char *field,
+                          const unsigned *array, xgt_ulong len);
+void XGDMatrixSetGroup(void *handle, const unsigned *group, xgt_ulong len);
+const float *XGDMatrixGetFloatInfo(const void *handle, const char *field,
+                                   xgt_ulong *out_len);
+const unsigned *XGDMatrixGetUIntInfo(const void *handle, const char *field,
+                                     xgt_ulong *out_len);
+xgt_ulong XGDMatrixNumRow(const void *handle);
+
+/* ---- Booster ---- */
+void *XGBoosterCreate(void *dmats[], xgt_ulong len);
+void XGBoosterFree(void *handle);
+void XGBoosterSetParam(void *handle, const char *name, const char *value);
+void XGBoosterUpdateOneIter(void *handle, int iter, void *dtrain);
+void XGBoosterBoostOneIter(void *handle, void *dtrain, float *grad,
+                           float *hess, xgt_ulong len);
+const char *XGBoosterEvalOneIter(void *handle, int iter, void *dmats[],
+                                 const char *evnames[], xgt_ulong len);
+const float *XGBoosterPredict(void *handle, void *dmat, int option_mask,
+                              unsigned ntree_limit, xgt_ulong *out_len);
+void XGBoosterLoadModel(void *handle, const char *fname);
+void XGBoosterSaveModel(const void *handle, const char *fname);
+void XGBoosterLoadModelFromBuffer(void *handle, const void *buf,
+                                  xgt_ulong len);
+const char *XGBoosterGetModelRaw(void *handle, xgt_ulong *out_len);
+const char **XGBoosterDumpModel(void *handle, const char *fmap,
+                                int with_stats, xgt_ulong *out_len);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* XGBOOST_TPU_CAPI_H_ */

@@ -1,0 +1,334 @@
+/*
+ * C ABI implementation: embeds CPython and drives
+ * xgboost_tpu.capi_bridge (see xgboost_tpu_capi.h for the contract;
+ * reference surface: wrapper/xgboost_wrapper.cpp:113-353).
+ *
+ * Handles are the bridge's integer registry keys boxed as void* — no
+ * Python object pointers ever cross the ABI.
+ *
+ * Threading: every entry point brackets ALL Python work (call + result
+ * conversion + decref) in PyGILState_Ensure/Release.  When this library
+ * itself initializes the interpreter it releases the GIL afterwards
+ * (PyEval_SaveThread), so calls may come from any host thread.
+ * Returned buffers are owned by per-handle anchors inside the bridge
+ * (freed with the handle / replaced by the next same-kind call).
+ */
+#include "xgboost_tpu_capi.h"
+
+#include <Python.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static PyObject *g_bridge = NULL;
+static pthread_once_t g_once = PTHREAD_ONCE_INIT;
+
+static void die_on_pyerr(const char *where) {
+  if (PyErr_Occurred()) {
+    fprintf(stderr, "xgboost_tpu C API error in %s:\n", where);
+    PyErr_Print();
+    exit(-1);
+  }
+}
+
+static void init_once(void) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_bridge = PyImport_ImportModule("xgboost_tpu.capi_bridge");
+    die_on_pyerr("import xgboost_tpu.capi_bridge (is the package on "
+                 "PYTHONPATH?)");
+    /* release the GIL we hold after Py_InitializeEx so other host
+     * threads can PyGILState_Ensure */
+    PyEval_SaveThread();
+  } else {
+    PyGILState_STATE g = PyGILState_Ensure();
+    g_bridge = PyImport_ImportModule("xgboost_tpu.capi_bridge");
+    die_on_pyerr("import xgboost_tpu.capi_bridge");
+    PyGILState_Release(g);
+  }
+}
+
+static PyGILState_STATE capi_enter(void) {
+  pthread_once(&g_once, init_once);
+  return PyGILState_Ensure();
+}
+
+static void capi_exit(PyGILState_STATE g) { PyGILState_Release(g); }
+
+/* Call a bridge function (GIL must be held). Returns a new reference
+ * (never NULL — errors abort). */
+static PyObject *bridge_call(const char *name, const char *fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject *args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  die_on_pyerr(name);
+  PyObject *fn = PyObject_GetAttrString(g_bridge, name);
+  die_on_pyerr(name);
+  PyObject *res = PyObject_CallObject(fn, args);
+  Py_XDECREF(fn);
+  Py_XDECREF(args);
+  die_on_pyerr(name);
+  return res;
+}
+
+static long h_of(const void *handle) { return (long)(intptr_t)handle; }
+
+/* ---- conversion helpers; GIL held, res consumed ---- */
+
+static void *take_handle(PyObject *res) {
+  long h = PyLong_AsLong(res);
+  Py_DECREF(res);
+  die_on_pyerr("handle");
+  return (void *)(intptr_t)h;
+}
+
+static void take_void(PyObject *res) { Py_XDECREF(res); }
+
+/* (addr, len) tuple -> pointer + out_len; buffer anchored bridge-side */
+static void *take_addr_len(PyObject *res, xgt_ulong *out_len) {
+  unsigned long long addr =
+      PyLong_AsUnsignedLongLong(PyTuple_GetItem(res, 0));
+  if (out_len != NULL)
+    *out_len = (xgt_ulong)PyLong_AsUnsignedLongLong(PyTuple_GetItem(res, 1));
+  Py_DECREF(res);
+  die_on_pyerr("addr_len");
+  return (void *)(uintptr_t)addr;
+}
+
+/* ------------------------------------------------------------- DMatrix */
+
+void *XGDMatrixCreateFromFile(const char *fname, int silent) {
+  PyGILState_STATE g = capi_enter();
+  void *h = take_handle(bridge_call("dmatrix_from_file", "(si)", fname,
+                                    silent));
+  capi_exit(g);
+  return h;
+}
+
+void *XGDMatrixCreateFromCSR(const xgt_ulong *indptr, const unsigned *indices,
+                             const float *data, xgt_ulong nindptr,
+                             xgt_ulong nelem) {
+  PyGILState_STATE g = capi_enter();
+  void *h = take_handle(bridge_call(
+      "dmatrix_from_csr", "(KKKKK)", (unsigned long long)(uintptr_t)indptr,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, (unsigned long long)nindptr,
+      (unsigned long long)nelem));
+  capi_exit(g);
+  return h;
+}
+
+void *XGDMatrixCreateFromCSC(const xgt_ulong *col_ptr, const unsigned *indices,
+                             const float *data, xgt_ulong nindptr,
+                             xgt_ulong nelem) {
+  PyGILState_STATE g = capi_enter();
+  void *h = take_handle(bridge_call(
+      "dmatrix_from_csc", "(KKKKK)", (unsigned long long)(uintptr_t)col_ptr,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, (unsigned long long)nindptr,
+      (unsigned long long)nelem));
+  capi_exit(g);
+  return h;
+}
+
+void *XGDMatrixCreateFromMat(const float *data, xgt_ulong nrow,
+                             xgt_ulong ncol, float missing) {
+  PyGILState_STATE g = capi_enter();
+  void *h = take_handle(bridge_call(
+      "dmatrix_from_mat", "(KKKf)", (unsigned long long)(uintptr_t)data,
+      (unsigned long long)nrow, (unsigned long long)ncol, missing));
+  capi_exit(g);
+  return h;
+}
+
+void *XGDMatrixSliceDMatrix(void *handle, const int *idxset, xgt_ulong len) {
+  PyGILState_STATE g = capi_enter();
+  void *h = take_handle(bridge_call(
+      "dmatrix_slice", "(lKK)", h_of(handle),
+      (unsigned long long)(uintptr_t)idxset, (unsigned long long)len));
+  capi_exit(g);
+  return h;
+}
+
+void XGDMatrixFree(void *handle) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("dmatrix_free", "(l)", h_of(handle)));
+  capi_exit(g);
+}
+
+void XGDMatrixSaveBinary(void *handle, const char *fname, int silent) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("dmatrix_save_binary", "(lsi)", h_of(handle), fname,
+                        silent));
+  capi_exit(g);
+}
+
+void XGDMatrixSetFloatInfo(void *handle, const char *field,
+                           const float *array, xgt_ulong len) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("dmatrix_set_float_info", "(lsKK)", h_of(handle),
+                        field, (unsigned long long)(uintptr_t)array,
+                        (unsigned long long)len));
+  capi_exit(g);
+}
+
+void XGDMatrixSetUIntInfo(void *handle, const char *field,
+                          const unsigned *array, xgt_ulong len) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("dmatrix_set_uint_info", "(lsKK)", h_of(handle),
+                        field, (unsigned long long)(uintptr_t)array,
+                        (unsigned long long)len));
+  capi_exit(g);
+}
+
+void XGDMatrixSetGroup(void *handle, const unsigned *group, xgt_ulong len) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("dmatrix_set_group", "(lKK)", h_of(handle),
+                        (unsigned long long)(uintptr_t)group,
+                        (unsigned long long)len));
+  capi_exit(g);
+}
+
+const float *XGDMatrixGetFloatInfo(const void *handle, const char *field,
+                                   xgt_ulong *out_len) {
+  PyGILState_STATE g = capi_enter();
+  const float *p = (const float *)take_addr_len(
+      bridge_call("dmatrix_get_float_info", "(ls)", h_of(handle), field),
+      out_len);
+  capi_exit(g);
+  return p;
+}
+
+const unsigned *XGDMatrixGetUIntInfo(const void *handle, const char *field,
+                                     xgt_ulong *out_len) {
+  PyGILState_STATE g = capi_enter();
+  const unsigned *p = (const unsigned *)take_addr_len(
+      bridge_call("dmatrix_get_uint_info", "(ls)", h_of(handle), field),
+      out_len);
+  capi_exit(g);
+  return p;
+}
+
+xgt_ulong XGDMatrixNumRow(const void *handle) {
+  PyGILState_STATE g = capi_enter();
+  PyObject *res = bridge_call("dmatrix_num_row", "(l)", h_of(handle));
+  xgt_ulong n = (xgt_ulong)PyLong_AsUnsignedLongLong(res);
+  Py_DECREF(res);
+  capi_exit(g);
+  return n;
+}
+
+/* ------------------------------------------------------------- Booster */
+
+static PyObject *handle_list(void *handles[], xgt_ulong len) {
+  PyObject *lst = PyList_New((Py_ssize_t)len);
+  for (xgt_ulong i = 0; i < len; ++i)
+    PyList_SetItem(lst, (Py_ssize_t)i, PyLong_FromLong(h_of(handles[i])));
+  return lst;
+}
+
+void *XGBoosterCreate(void *dmats[], xgt_ulong len) {
+  PyGILState_STATE g = capi_enter();
+  void *h = take_handle(
+      bridge_call("booster_create", "(N)", handle_list(dmats, len)));
+  capi_exit(g);
+  return h;
+}
+
+void XGBoosterFree(void *handle) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("booster_free", "(l)", h_of(handle)));
+  capi_exit(g);
+}
+
+void XGBoosterSetParam(void *handle, const char *name, const char *value) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("booster_set_param", "(lss)", h_of(handle), name,
+                        value));
+  capi_exit(g);
+}
+
+void XGBoosterUpdateOneIter(void *handle, int iter, void *dtrain) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("booster_update_one_iter", "(lil)", h_of(handle),
+                        iter, h_of(dtrain)));
+  capi_exit(g);
+}
+
+void XGBoosterBoostOneIter(void *handle, void *dtrain, float *grad,
+                           float *hess, xgt_ulong len) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("booster_boost_one_iter", "(llKKK)", h_of(handle),
+                        h_of(dtrain), (unsigned long long)(uintptr_t)grad,
+                        (unsigned long long)(uintptr_t)hess,
+                        (unsigned long long)len));
+  capi_exit(g);
+}
+
+const char *XGBoosterEvalOneIter(void *handle, int iter, void *dmats[],
+                                 const char *evnames[], xgt_ulong len) {
+  PyGILState_STATE g = capi_enter();
+  PyObject *hs = handle_list(dmats, len);
+  PyObject *names = PyList_New((Py_ssize_t)len);
+  for (xgt_ulong i = 0; i < len; ++i)
+    PyList_SetItem(names, (Py_ssize_t)i, PyUnicode_FromString(evnames[i]));
+  const char *s = (const char *)take_addr_len(
+      bridge_call("booster_eval_one_iter", "(liNN)", h_of(handle), iter, hs,
+                  names),
+      NULL);
+  capi_exit(g);
+  return s;
+}
+
+const float *XGBoosterPredict(void *handle, void *dmat, int option_mask,
+                              unsigned ntree_limit, xgt_ulong *out_len) {
+  PyGILState_STATE g = capi_enter();
+  const float *p = (const float *)take_addr_len(
+      bridge_call("booster_predict", "(llii)", h_of(handle), h_of(dmat),
+                  option_mask, (int)ntree_limit),
+      out_len);
+  capi_exit(g);
+  return p;
+}
+
+void XGBoosterLoadModel(void *handle, const char *fname) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("booster_load_model", "(ls)", h_of(handle), fname));
+  capi_exit(g);
+}
+
+void XGBoosterSaveModel(const void *handle, const char *fname) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("booster_save_model", "(ls)", h_of(handle), fname));
+  capi_exit(g);
+}
+
+void XGBoosterLoadModelFromBuffer(void *handle, const void *buf,
+                                  xgt_ulong len) {
+  PyGILState_STATE g = capi_enter();
+  take_void(bridge_call("booster_load_model_from_buffer", "(lKK)",
+                        h_of(handle), (unsigned long long)(uintptr_t)buf,
+                        (unsigned long long)len));
+  capi_exit(g);
+}
+
+const char *XGBoosterGetModelRaw(void *handle, xgt_ulong *out_len) {
+  PyGILState_STATE g = capi_enter();
+  const char *p = (const char *)take_addr_len(
+      bridge_call("booster_get_model_raw", "(l)", h_of(handle)), out_len);
+  capi_exit(g);
+  return p;
+}
+
+const char **XGBoosterDumpModel(void *handle, const char *fmap,
+                                int with_stats, xgt_ulong *out_len) {
+  PyGILState_STATE g = capi_enter();
+  const char **p = (const char **)take_addr_len(
+      bridge_call("booster_dump_model", "(lsi)", h_of(handle),
+                  fmap ? fmap : "", with_stats),
+      out_len);
+  capi_exit(g);
+  return p;
+}

@@ -1,0 +1,64 @@
+/*
+ * C-host smoke driver for the xgboost_tpu C ABI: train agaricus from a
+ * non-Python host, eval, predict, save/load round-trip, dump.
+ * Mirrors the reference's basic walkthrough through its C wrapper.
+ * Usage: capi_demo <train.libsvm> <test.libsvm> <model_out>
+ */
+#include <stdio.h>
+#include <string.h>
+
+#include "xgboost_tpu_capi.h"
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s train test model_out\n", argv[0]);
+    return 2;
+  }
+  void *dtrain = XGDMatrixCreateFromFile(argv[1], 1);
+  void *dtest = XGDMatrixCreateFromFile(argv[2], 1);
+  printf("rows train=%lu test=%lu\n", XGDMatrixNumRow(dtrain),
+         XGDMatrixNumRow(dtest));
+
+  void *dmats[2] = {dtrain, dtest};
+  void *bst = XGBoosterCreate(dmats, 2);
+  XGBoosterSetParam(bst, "objective", "binary:logistic");
+  XGBoosterSetParam(bst, "max_depth", "3");
+  XGBoosterSetParam(bst, "eta", "1.0");
+
+  const char *names[2] = {"train", "test"};
+  for (int i = 0; i < 2; ++i) {
+    XGBoosterUpdateOneIter(bst, i, dtrain);
+    printf("%s\n", XGBoosterEvalOneIter(bst, i, dmats, names, 2));
+  }
+
+  xgt_ulong n = 0;
+  const float *preds = XGBoosterPredict(bst, dtest, 0, 0, &n);
+  printf("npred=%lu pred0=%.6f\n", n, preds[0]);
+
+  XGBoosterSaveModel(bst, argv[3]);
+  void *bst2 = XGBoosterCreate(dmats, 0);
+  XGBoosterLoadModel(bst2, argv[3]);
+  xgt_ulong n2 = 0;
+  const float *p2 = XGBoosterPredict(bst2, dtest, 0, 0, &n2);
+  int same = (n == n2);
+  for (xgt_ulong i = 0; same && i < n; ++i) same = (preds[i] == p2[i]);
+  /* note: preds ptr was invalidated by the second Predict on bst2?  No:
+   * anchors are per-handle, bst != bst2, so both stay valid. */
+  printf("roundtrip=%s\n", same ? "identical" : "MISMATCH");
+
+  xgt_ulong ntree = 0;
+  const char **dump = XGBoosterDumpModel(bst, "", 0, &ntree);
+  printf("dump trees=%lu first_node_ok=%d\n", ntree,
+         dump[0] != NULL && strstr(dump[0], "0:[") != NULL);
+
+  xgt_ulong rawlen = 0;
+  XGBoosterGetModelRaw(bst, &rawlen);
+  printf("rawlen=%lu\n", rawlen);
+
+  XGBoosterFree(bst2);
+  XGBoosterFree(bst);
+  XGDMatrixFree(dtest);
+  XGDMatrixFree(dtrain);
+  printf("C-ABI-OK\n");
+  return 0;
+}

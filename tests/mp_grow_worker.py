@@ -57,7 +57,6 @@ def main():
     gh_local = np.stack([p - y[sl], p * (1 - p)], axis=1).astype(np.float32)
 
     mesh = data_parallel_mesh()
-    shard = NamedSharding(mesh, P("data"))
 
     def globalize(a):
         return jax.make_array_from_process_local_data(

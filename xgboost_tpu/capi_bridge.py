@@ -1,0 +1,211 @@
+"""Python side of the C ABI (native/xgtpu_capi.c).
+
+The reference serves non-Python hosts through a C shim over its C++
+core (``wrapper/xgboost_wrapper.cpp:113-353``).  Here the compute core
+IS Python/JAX, so the C ABI embeds the interpreter and calls into this
+bridge: C passes raw pointers as integers, the bridge wraps them with
+ctypes/numpy (zero-copy views), and keeps any array/string it returns
+alive until the owning handle is freed or the next call of the same
+kind (the reference's pointer-validity contract).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List
+
+import numpy as np
+
+_objects: Dict[int, object] = {}
+_next_handle = [1]
+# return-buffer anchors: (owner_handle, kind) -> object kept alive
+_anchors: Dict[tuple, object] = {}
+
+
+def _new_handle(obj) -> int:
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _objects[h] = obj
+    return h
+
+
+def _arr(addr: int, length: int, dtype) -> np.ndarray:
+    if length == 0:
+        return np.zeros(0, dtype=dtype)
+    ct = np.ctypeslib.as_ctypes_type(dtype)
+    buf = (ct * length).from_address(addr)
+    return np.ctypeslib.as_array(buf).copy()
+
+
+def _anchor(owner: int, kind: str, obj) -> int:
+    """Keep obj alive keyed by (owner, kind); return its data address."""
+    _anchors[(owner, kind)] = obj
+    if isinstance(obj, np.ndarray):
+        return obj.ctypes.data
+    if isinstance(obj, ctypes.Array):
+        return ctypes.addressof(obj)
+    raise TypeError(type(obj))
+
+
+def _anchor_str(owner: int, kind: str, s: str) -> tuple:
+    """Anchor a NUL-terminated char buffer; returns (addr, strlen)."""
+    raw = s.encode()
+    buf = ctypes.create_string_buffer(raw)  # includes the trailing NUL
+    return _anchor(owner, kind, buf), len(raw)
+
+
+# ------------------------------------------------------------------ dmatrix
+
+def dmatrix_from_file(fname: str, silent: int) -> int:
+    from xgboost_tpu import DMatrix
+    return _new_handle(DMatrix(fname, silent=bool(silent)))
+
+
+def dmatrix_from_csr(indptr_addr, indices_addr, data_addr,
+                     nindptr, nelem) -> int:
+    from xgboost_tpu import DMatrix
+    indptr = _arr(indptr_addr, nindptr, np.uint64).astype(np.int64)
+    indices = _arr(indices_addr, nelem, np.uint32).astype(np.int32)
+    values = _arr(data_addr, nelem, np.float32)
+    num_col = int(indices.max()) + 1 if nelem else 0
+    return _new_handle(DMatrix((indptr, indices, values, num_col)))
+
+
+def dmatrix_from_csc(colptr_addr, indices_addr, data_addr,
+                     nindptr, nelem) -> int:
+    from xgboost_tpu import DMatrix
+    colptr = _arr(colptr_addr, nindptr, np.uint64).astype(np.int64)
+    rows = _arr(indices_addr, nelem, np.uint32).astype(np.int64)
+    values = _arr(data_addr, nelem, np.float32)
+    ncol = nindptr - 1
+    cols = np.repeat(np.arange(ncol, dtype=np.int64), np.diff(colptr))
+    order = np.lexsort((cols, rows))  # row-major CSR ordering
+    nrow = int(rows.max()) + 1 if nelem else 0
+    counts = np.bincount(rows, minlength=nrow)
+    indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    return _new_handle(DMatrix((indptr, cols[order].astype(np.int32),
+                                values[order], ncol)))
+
+
+def dmatrix_from_mat(data_addr, nrow, ncol, missing: float) -> int:
+    from xgboost_tpu import DMatrix
+    X = _arr(data_addr, nrow * ncol, np.float32).reshape(nrow, ncol)
+    return _new_handle(DMatrix(X, missing=missing))
+
+
+def dmatrix_slice(h: int, idx_addr, length) -> int:
+    idx = _arr(idx_addr, length, np.int32)
+    return _new_handle(_objects[h].slice(idx))
+
+
+def dmatrix_save_binary(h: int, fname: str, silent: int) -> None:
+    _objects[h].save_binary(fname)
+
+
+def dmatrix_set_float_info(h: int, field: str, addr, length) -> None:
+    _objects[h].info.set_field(field, _arr(addr, length, np.float32))
+
+
+def dmatrix_set_uint_info(h: int, field: str, addr, length) -> None:
+    _objects[h].info.set_field(field, _arr(addr, length, np.uint32))
+
+
+def dmatrix_set_group(h: int, addr, length) -> None:
+    _objects[h].info.set_field("group", _arr(addr, length, np.uint32))
+
+
+def dmatrix_get_float_info(h: int, field: str) -> tuple:
+    v = _objects[h].info.get_field(field)
+    v = np.zeros(0, np.float32) if v is None else \
+        np.ascontiguousarray(v, np.float32)
+    return _anchor(h, "finfo", v), len(v)
+
+
+def dmatrix_get_uint_info(h: int, field: str) -> tuple:
+    v = _objects[h].info.get_field(field)
+    v = np.zeros(0, np.uint32) if v is None else \
+        np.ascontiguousarray(v, np.uint32)
+    return _anchor(h, "uinfo", v), len(v)
+
+
+def dmatrix_num_row(h: int) -> int:
+    return int(_objects[h].num_row)
+
+
+def dmatrix_free(h: int) -> None:
+    _objects.pop(h, None)
+    for key in [k for k in _anchors if k[0] == h]:
+        _anchors.pop(key)
+
+
+# ------------------------------------------------------------------ booster
+
+def booster_create(dmat_handles: List[int]) -> int:
+    from xgboost_tpu import Booster
+    cache = [_objects[h] for h in dmat_handles]
+    return _new_handle(Booster({}, cache=cache))
+
+
+def booster_set_param(h: int, name: str, value: str) -> None:
+    _objects[h].set_param({name: value})
+
+
+def booster_update_one_iter(h: int, it: int, dtrain: int) -> None:
+    _objects[h].update(_objects[dtrain], it)
+
+
+def booster_boost_one_iter(h: int, dtrain: int, grad_addr, hess_addr,
+                           length) -> None:
+    _objects[h].boost(_objects[dtrain],
+                      _arr(grad_addr, length, np.float32),
+                      _arr(hess_addr, length, np.float32))
+
+
+def booster_eval_one_iter(h: int, it: int, dmat_handles: List[int],
+                          names: List[str]) -> tuple:
+    evals = [(_objects[d], n) for d, n in zip(dmat_handles, names)]
+    return _anchor_str(h, "eval", _objects[h].eval_set(evals, it))
+
+
+def booster_predict(h: int, dmat: int, option_mask: int,
+                    ntree_limit: int) -> tuple:
+    bst = _objects[h]
+    out = bst.predict(_objects[dmat],
+                      output_margin=bool(option_mask & 1),
+                      ntree_limit=ntree_limit,
+                      pred_leaf=bool(option_mask & 2))
+    out = np.ascontiguousarray(np.asarray(out, np.float32)).ravel()
+    return _anchor(h, "pred", out), len(out)
+
+
+def booster_load_model(h: int, fname: str) -> None:
+    _objects[h].load_model(fname)
+
+
+def booster_save_model(h: int, fname: str) -> None:
+    _objects[h].save_model(fname)
+
+
+def booster_load_model_from_buffer(h: int, addr, length) -> None:
+    raw = bytes(_arr(addr, length, np.uint8).tobytes())
+    _objects[h].load_raw(raw)
+
+
+def booster_get_model_raw(h: int) -> tuple:
+    raw = np.frombuffer(_objects[h].save_raw(), dtype=np.uint8).copy()
+    return _anchor(h, "raw", raw), len(raw)
+
+
+def booster_dump_model(h: int, fmap: str, with_stats: int) -> tuple:
+    """Anchored char** array: (address of pointer table, n_trees)."""
+    dumps = _objects[h].get_dump(fmap=fmap or "",
+                                 with_stats=bool(with_stats))
+    bufs = [ctypes.create_string_buffer(s.encode()) for s in dumps]
+    ptrs = (ctypes.c_void_p * max(len(bufs), 1))(
+        *[ctypes.addressof(b) for b in bufs])
+    _anchors[(h, "dump")] = (bufs, ptrs)
+    return ctypes.addressof(ptrs), len(bufs)
+
+
+def booster_free(h: int) -> None:
+    dmatrix_free(h)

@@ -553,23 +553,30 @@ class Booster:
 
     def load_model(self, path: str):
         with open(path, "rb") as f:
-            head = f.read(5)
+            raw = f.read()
+        self.load_raw(raw, name=path)
+
+    def load_raw(self, raw: bytes, name: str = "<buffer>"):
+        """Load a model from an in-memory buffer (reference
+        XGBoosterLoadModelFromBuffer, wrapper/xgboost_wrapper.cpp:338-341).
+        Sniffs the same formats as load_model: our npz, base64 text-safe
+        (bs64), or the reference binary stream (binf / reference bs64)."""
+        import io
+        head = raw[:5]
         if head[:4] in (b"binf", b"bs64") and head != b"bs64\t":
-            # reference binary format (binf, or bs64 of the reference
-            # stream): delegate to the compat reader
-            self._load_reference(path)
+            # reference binary format: delegate to the compat reader
+            self._load_reference(raw)
             return
         if head == b"bs64\t":
             import base64
-            import io
-            with open(path, "rb") as f:
-                raw = base64.b64decode(b"".join(f.read()[5:].split()))
-            if not raw.startswith(b"PK"):  # not our npz: reference stream
-                self._load_reference(raw)
+            dec = base64.b64decode(b"".join(raw[5:].split()))
+            if not dec.startswith(b"PK"):  # not our npz: reference stream
+                self._load_reference(dec)
                 return
-            src = io.BytesIO(raw)
-        else:
-            src = path
+            raw = dec
+        self._load_np(io.BytesIO(raw), name)
+
+    def _load_np(self, src, path):
         try:
             z = np.load(src, allow_pickle=False)
         except Exception as e:

@@ -7,9 +7,11 @@ report_stats idea".  Two levels:
 
 - ``profile=1`` — host-side phase timing per boosting round (predict /
   gradient / grow / eval), printed per round and summarized at the end.
-  Phases force ``block_until_ready`` at their boundaries so async
-  dispatch doesn't smear costs across phases (small overhead; off by
-  default).
+  Phases force a true device barrier at their boundaries so async
+  dispatch doesn't smear costs across phases.  On remote-attached
+  backends (tunnels) a barrier costs a full round-trip, so per-phase
+  numbers are inflated by that constant — see PROFILE.md; off by
+  default.
 - ``profile=2`` — additionally captures a ``jax.profiler`` trace into
   ``profile_dir`` (default ``./xgtpu_profile``) for XProf/TensorBoard —
   the device-side view of kernel time.
@@ -112,6 +114,13 @@ class _Phase:
         if self._blocked is not None and exc[0] is None:
             import jax
             jax.block_until_ready(self._blocked)
+            # block_until_ready is advisory on some remote-attached
+            # backends (axon tunnel); one single-element host pull is a
+            # true barrier on the in-order stream (last leaf suffices)
+            leaves = [x for x in jax.tree.leaves(self._blocked)
+                      if hasattr(x, "ravel")]
+            if leaves:
+                jax.device_get(leaves[-1].ravel()[:1])
         cur = self.prof._current
         if cur is None and self.prof.rounds:
             # outside begin/end (e.g. eval after end_round): fold into
