@@ -1,0 +1,115 @@
+# Training / prediction / model IO (counterpart of the reference
+# R-package/R/xgb.train.R, xgboost.R, predict.xgb.Booster.R,
+# xgb.save.R, xgb.load.R, xgb.dump.R).
+
+.plist <- function(params) {
+  # R list -> python dict of strings/numbers (eval_metric may be a vector)
+  reticulate::r_to_py(params)
+}
+
+#' Train a boosted model (reference xgb.train semantics).
+#'
+#' @param params named list of booster parameters
+#' @param data xgb.DMatrix
+#' @param nrounds number of boosting rounds
+#' @param watchlist named list of xgb.DMatrix to evaluate per round
+#' @param early_stopping_rounds stop when no improvement for this many
+#' @param verbose 0/1
+#' @export
+xgb.train <- function(params = list(), data, nrounds,
+                      watchlist = list(), obj = NULL,
+                      early_stopping_rounds = NULL, maximize = NULL,
+                      verbose = 1, ...) {
+  stopifnot(inherits(data, "xgb.DMatrix"))
+  core <- .core()
+  evals <- lapply(names(watchlist), function(n) {
+    reticulate::tuple(watchlist[[n]]$handle, n)
+  })
+  bst <- core$train(
+    .plist(c(params, list(...))), data$handle, as.integer(nrounds),
+    evals = evals,
+    early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL
+                            else as.integer(early_stopping_rounds),
+    maximize = maximize,
+    verbose_eval = verbose > 0)
+  structure(list(handle = bst), class = "xgb.Booster")
+}
+
+#' Simple interface: train on a matrix + label (reference xgboost()).
+#' @export
+xgboost <- function(data, label = NULL, params = list(), nrounds,
+                    verbose = 1, ...) {
+  dtrain <- if (inherits(data, "xgb.DMatrix")) data
+            else xgb.DMatrix(data, label = label)
+  xgb.train(params, dtrain, nrounds, verbose = verbose, ...)
+}
+
+#' Predict with a trained booster.
+#' @param outputmargin return untransformed margin scores
+#' @param ntreelimit use only the first N trees
+#' @param predleaf return per-tree leaf indices
+#' @export
+predict.xgb.Booster <- function(object, newdata, outputmargin = FALSE,
+                                ntreelimit = 0, predleaf = FALSE, ...) {
+  d <- if (inherits(newdata, "xgb.DMatrix")) newdata
+       else xgb.DMatrix(newdata)
+  out <- object$handle$predict(d$handle,
+                               output_margin = outputmargin,
+                               ntree_limit = as.integer(ntreelimit),
+                               pred_leaf = predleaf)
+  out <- reticulate::py_to_r(out)
+  if (is.matrix(out) && ncol(out) == 1 && !predleaf) out <- drop(out)
+  out
+}
+
+#' Save a model to a file (own npz format, or text-safe base64).
+#' @export
+xgb.save <- function(model, fname) {
+  stopifnot(inherits(model, "xgb.Booster"))
+  model$handle$save_model(fname)
+  invisible(TRUE)
+}
+
+#' Serialized model as a raw vector.
+#' @export
+xgb.save.raw <- function(model) {
+  stopifnot(inherits(model, "xgb.Booster"))
+  reticulate::py_to_r(model$handle$save_raw())
+}
+
+#' Load a model (ours or a reference-format binary).
+#' @export
+xgb.load <- function(fname) {
+  core <- .core()
+  structure(list(handle = core$Booster(model_file = fname)),
+            class = "xgb.Booster")
+}
+
+#' Text dump of every tree; optionally to a file with a feature map.
+#' @export
+xgb.dump <- function(model, fname = NULL, fmap = "", with_stats = FALSE) {
+  stopifnot(inherits(model, "xgb.Booster"))
+  dumps <- reticulate::py_to_r(
+    model$handle$get_dump(fmap = fmap, with_stats = with_stats))
+  txt <- unlist(lapply(seq_along(dumps), function(i) {
+    c(sprintf("booster[%d]:", i - 1L),
+      strsplit(dumps[[i]], "\n", fixed = TRUE)[[1]])
+  }))
+  if (is.null(fname)) return(txt)
+  writeLines(txt, fname)
+  invisible(TRUE)
+}
+
+#' k-fold cross validation (reference xgb.cv).
+#' @export
+xgb.cv <- function(params = list(), data, nrounds, nfold,
+                   metrics = list(), verbose = 1, ...) {
+  stopifnot(inherits(data, "xgb.DMatrix"))
+  core <- .core()
+  res <- core$cv(.plist(c(params, list(...))), data$handle,
+                 num_boost_round = as.integer(nrounds),
+                 nfold = as.integer(nfold),
+                 metrics = as.list(metrics),
+                 verbose_eval = verbose > 0)
+  reticulate::py_to_r(res)
+}

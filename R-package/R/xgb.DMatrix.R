@@ -1,0 +1,85 @@
+# xgb.DMatrix: data container (counterpart of the reference R package's
+# xgb.DMatrix over the C ABI, R-package/R/xgb.DMatrix.R; here the core
+# is reached through reticulate).
+
+.xgbtpu_env <- new.env(parent = emptyenv())
+
+#' Lazily import the xgboost_tpu Python package.
+.core <- function() {
+  if (is.null(.xgbtpu_env$core)) {
+    .xgbtpu_env$core <- reticulate::import("xgboost_tpu", delay_load = FALSE)
+  }
+  .xgbtpu_env$core
+}
+
+#' Construct an xgb.DMatrix from a dense matrix, a dgCMatrix, or a
+#' libsvm/binary file path.
+#'
+#' @param data matrix, Matrix::dgCMatrix, or character path
+#' @param label optional numeric label vector
+#' @param weight optional instance weights
+#' @param missing value treated as missing in dense input (default NA)
+#' @export
+xgb.DMatrix <- function(data, label = NULL, weight = NULL, missing = NA,
+                        ...) {
+  core <- .core()
+  if (is.character(data)) {
+    handle <- core$DMatrix(data, ...)
+  } else if (inherits(data, "dgCMatrix")) {
+    # CSC -> (indptr, indices, values) CSR via Python-side transposition
+    sp <- reticulate::import("scipy.sparse")
+    csr <- sp$csc_matrix(reticulate::tuple(
+      as.numeric(data@x), as.integer(data@i), as.integer(data@p)),
+      shape = reticulate::tuple(nrow(data), ncol(data)))$tocsr()
+    handle <- core$DMatrix(csr, ...)
+  } else if (is.matrix(data)) {
+    storage.mode(data) <- "double"
+    if (!is.na(missing)) data[data == missing] <- NA_real_
+    handle <- core$DMatrix(reticulate::r_to_py(data), ...)
+  } else {
+    stop("xgb.DMatrix: unsupported data type ", class(data)[1])
+  }
+  if (!is.null(label)) handle$set_label(as.numeric(label))
+  if (!is.null(weight)) handle$set_weight(as.numeric(weight))
+  structure(list(handle = handle), class = "xgb.DMatrix")
+}
+
+#' @export
+dim.xgb.DMatrix <- function(x) {
+  c(x$handle$num_row, x$handle$num_col)
+}
+
+#' Set a meta field ("label", "weight", "base_margin", "group").
+#' @export
+setinfo <- function(object, name, info) {
+  stopifnot(inherits(object, "xgb.DMatrix"))
+  if (name == "group") {
+    object$handle$set_group(as.integer(info))
+  } else {
+    object$handle$info$set_field(name, as.numeric(info))
+  }
+  invisible(object)
+}
+
+#' Get a meta field.
+#' @export
+getinfo <- function(object, name) {
+  stopifnot(inherits(object, "xgb.DMatrix"))
+  as.numeric(object$handle$info$get_field(name))
+}
+
+#' Row-subset an xgb.DMatrix (1-based R indices).
+#' @export
+slice <- function(object, idxset) {
+  stopifnot(inherits(object, "xgb.DMatrix"))
+  structure(list(handle = object$handle$slice(as.integer(idxset - 1L))),
+            class = "xgb.DMatrix")
+}
+
+#' Save an xgb.DMatrix to a binary cache file.
+#' @export
+xgb.DMatrix.save <- function(dmatrix, fname) {
+  stopifnot(inherits(dmatrix, "xgb.DMatrix"))
+  dmatrix$handle$save_binary(fname)
+  invisible(TRUE)
+}

@@ -1,0 +1,71 @@
+# Feature importance + tree table, parsed from the text dump in pure R
+# (counterpart of reference R-package/R/xgb.importance.R and
+# xgb.model.dt.tree.R; same Gain/Cover/Frequency semantics).
+
+#' Parse the dump into a data.frame of nodes.
+#'
+#' Columns: Tree, Node, Feature ("Leaf" for leaves), Split, Yes, No,
+#' Missing, Quality (gain or leaf value), Cover (when dumped with
+#' stats).
+#' @export
+xgb.model.dt.tree <- function(model = NULL, text = NULL, fmap = "") {
+  if (is.null(text)) {
+    stopifnot(inherits(model, "xgb.Booster"))
+    text <- xgb.dump(model, fmap = fmap, with_stats = TRUE)
+  }
+  tree_id <- -1L
+  rows <- list()
+  for (line in text) {
+    if (grepl("^booster\\[", line)) {
+      tree_id <- tree_id + 1L
+      next
+    }
+    s <- trimws(line)
+    if (s == "") next
+    node <- as.integer(sub("^([0-9]+):.*$", "\\1", s))
+    if (grepl("leaf=", s, fixed = TRUE)) {
+      qual <- as.numeric(sub(".*leaf=([^,]+).*", "\\1", s))
+      cover <- if (grepl("cover=", s)) as.numeric(
+        sub(".*cover=([^,]+).*", "\\1", s)) else NA_real_
+      rows[[length(rows) + 1L]] <- data.frame(
+        Tree = tree_id, Node = node, Feature = "Leaf", Split = NA_real_,
+        Yes = NA_integer_, No = NA_integer_, Missing = NA_integer_,
+        Quality = qual, Cover = cover, stringsAsFactors = FALSE)
+    } else {
+      feat <- sub("^[0-9]+:\\[([^<]+)<.*$", "\\1", s)
+      split <- as.numeric(sub("^[0-9]+:\\[[^<]+<([^]]+)\\].*$", "\\1", s))
+      yes <- as.integer(sub(".*yes=([0-9]+).*", "\\1", s))
+      no <- as.integer(sub(".*no=([0-9]+).*", "\\1", s))
+      miss <- as.integer(sub(".*missing=([0-9]+).*", "\\1", s))
+      qual <- if (grepl("gain=", s)) as.numeric(
+        sub(".*gain=([^,]+).*", "\\1", s)) else NA_real_
+      cover <- if (grepl("cover=", s)) as.numeric(
+        sub(".*cover=([^,]+).*", "\\1", s)) else NA_real_
+      rows[[length(rows) + 1L]] <- data.frame(
+        Tree = tree_id, Node = node, Feature = feat, Split = split,
+        Yes = yes, No = no, Missing = miss, Quality = qual,
+        Cover = cover, stringsAsFactors = FALSE)
+    }
+  }
+  do.call(rbind, rows)
+}
+
+#' Per-feature importance: total Gain, Cover and split Frequency,
+#' normalized to sum to 1 (reference xgb.importance semantics).
+#' @export
+xgb.importance <- function(model = NULL, feature_names = NULL,
+                           text = NULL, fmap = "") {
+  dt <- xgb.model.dt.tree(model = model, text = text, fmap = fmap)
+  dt <- dt[dt$Feature != "Leaf", , drop = FALSE]
+  if (nrow(dt) == 0) {
+    return(data.frame(Feature = character(), Gain = numeric(),
+                      Cover = numeric(), Frequency = numeric()))
+  }
+  agg <- aggregate(cbind(Gain = dt$Quality, Cover = dt$Cover,
+                         Frequency = rep(1, nrow(dt))),
+                   by = list(Feature = dt$Feature), FUN = sum)
+  agg$Gain <- agg$Gain / sum(agg$Gain)
+  if (!all(is.na(agg$Cover))) agg$Cover <- agg$Cover / sum(agg$Cover)
+  agg$Frequency <- agg$Frequency / sum(agg$Frequency)
+  agg[order(-agg$Gain), , drop = FALSE]
+}

@@ -1,0 +1,67 @@
+# End-to-end R binding tests (run where R + reticulate + the Python
+# package are available; the CI image for this repo has no R runtime,
+# so these are exercised on developer machines — see ../../README.md).
+
+library(testthat)
+library(xgboosttpu)
+
+agaricus_train <- Sys.getenv("XGBTPU_AGARICUS_TRAIN",
+  "/root/reference/demo/data/agaricus.txt.train")
+agaricus_test <- Sys.getenv("XGBTPU_AGARICUS_TEST",
+  "/root/reference/demo/data/agaricus.txt.test")
+
+test_that("dense matrix train/predict round-trips", {
+  set.seed(1)
+  x <- matrix(runif(200 * 4), ncol = 4)
+  y <- as.numeric(x[, 1] > 0.5)
+  bst <- xgboost(x, label = y,
+                 params = list(objective = "binary:logistic",
+                               max_depth = 2, eta = 1),
+                 nrounds = 3, verbose = 0)
+  p <- predict(bst, x)
+  expect_equal(length(p), 200)
+  expect_gt(mean((p > 0.5) == y), 0.95)
+
+  f <- tempfile(fileext = ".model")
+  xgb.save(bst, f)
+  bst2 <- xgb.load(f)
+  expect_identical(predict(bst2, x), p)
+})
+
+test_that("agaricus matches the reference demo error", {
+  skip_if_not(file.exists(agaricus_train))
+  dtrain <- xgb.DMatrix(agaricus_train)
+  dtest <- xgb.DMatrix(agaricus_test)
+  bst <- xgb.train(list(objective = "binary:logistic", max_depth = 3,
+                        eta = 1),
+                   dtrain, 2,
+                   watchlist = list(train = dtrain, test = dtest),
+                   verbose = 0)
+  p <- predict(bst, dtest)
+  err <- mean((p > 0.5) != getinfo(dtest, "label"))
+  expect_lt(err, 0.01)
+})
+
+test_that("dump, importance and tree table parse", {
+  set.seed(2)
+  x <- matrix(runif(300 * 5), ncol = 5)
+  y <- as.numeric(x[, 2] > 0.4)
+  bst <- xgboost(x, label = y,
+                 params = list(max_depth = 3), nrounds = 2, verbose = 0)
+  txt <- xgb.dump(bst, with_stats = TRUE)
+  expect_true(any(grepl("^booster\\[0\\]", txt)))
+  dt <- xgb.model.dt.tree(bst)
+  expect_true(all(c("Tree", "Feature", "Quality") %in% names(dt)))
+  imp <- xgb.importance(bst)
+  expect_equal(sum(imp$Gain), 1, tolerance = 1e-6)
+  expect_equal(imp$Feature[1], "f1")  # x[,2] drives the label
+})
+
+test_that("setinfo/getinfo/slice behave", {
+  x <- matrix(runif(50 * 3), ncol = 3)
+  d <- xgb.DMatrix(x, label = rep(0, 50))
+  setinfo(d, "weight", seq_len(50))
+  expect_equal(getinfo(d, "weight"), as.numeric(seq_len(50)))
+  s <- slice(d, 1:10)
+  expect_equal(dim(s)[1], 10)
+})

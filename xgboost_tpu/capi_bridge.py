@@ -3,10 +3,11 @@
 The reference serves non-Python hosts through a C shim over its C++
 core (``wrapper/xgboost_wrapper.cpp:113-353``).  Here the compute core
 IS Python/JAX, so the C ABI embeds the interpreter and calls into this
-bridge: C passes raw pointers as integers, the bridge wraps them with
-ctypes/numpy (zero-copy views), and keeps any array/string it returns
-alive until the owning handle is freed or the next call of the same
-kind (the reference's pointer-validity contract).
+bridge: C passes raw pointers as integers, the bridge COPIES the data
+at the boundary (callers may free their buffers on return), and keeps
+any array/string it returns alive until the owning handle is freed or
+the next call of the same kind (the reference's pointer-validity
+contract).
 """
 
 from __future__ import annotations
@@ -187,8 +188,7 @@ def booster_save_model(h: int, fname: str) -> None:
 
 
 def booster_load_model_from_buffer(h: int, addr, length) -> None:
-    raw = bytes(_arr(addr, length, np.uint8).tobytes())
-    _objects[h].load_raw(raw)
+    _objects[h].load_raw(ctypes.string_at(addr, length))
 
 
 def booster_get_model_raw(h: int) -> tuple:
