@@ -21,13 +21,15 @@ xgb.train <- function(params = list(), data, nrounds,
                       early_stopping_rounds = NULL, maximize = NULL,
                       verbose = 1, ...) {
   stopifnot(inherits(data, "xgb.DMatrix"))
+  if (length(watchlist) > 0 && is.null(names(watchlist)))
+    stop("watchlist must be a NAMED list, e.g. list(train = dtrain)")
   core <- .core()
   evals <- lapply(names(watchlist), function(n) {
     reticulate::tuple(watchlist[[n]]$handle, n)
   })
   bst <- core$train(
     .plist(c(params, list(...))), data$handle, as.integer(nrounds),
-    evals = evals,
+    evals = evals, obj = obj,
     early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL
                             else as.integer(early_stopping_rounds),
     maximize = maximize,

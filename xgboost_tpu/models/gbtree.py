@@ -45,6 +45,7 @@ class GBTree:
         self.param = param
         self.cuts = cuts
         self.cfg = make_grow_config(param, cuts.max_bin)
+        self._split_finder_cache = None  # stable identity (jit static arg)
         self.trees: List[TreeArrays] = []      # device pytrees, one per tree
         self.tree_group: List[int] = []
         self._stack_cache: Optional[Tuple[int, TreeArrays, jax.Array]] = None
@@ -63,6 +64,22 @@ class GBTree:
                              fill=jnp.inf),
                 pad_features(self.n_cuts_dev, n_shard, axis=0))
         return self._col_pad_cache[1], self._col_pad_cache[2]
+
+    def _split_finder(self):
+        """The pluggable split finder: skmaker's 3-way sketch selection
+        when updater=grow_skmaker, else None (= histogram argmax).
+        Cached so the jitted growers see a stable static identity."""
+        if self._split_finder_cache is None:
+            from xgboost_tpu.models.updaters import parse_updaters
+            if "grow_skmaker" in parse_updaters(self.param.updater):
+                from xgboost_tpu.models.skmaker import skmaker_split_finder
+                K = max(4, int(self.param.sketch_ratio
+                               / max(self.param.sketch_eps, 1e-6)))
+                self._split_finder_cache = skmaker_split_finder(
+                    min(K, self.cfg.n_bin))
+            else:
+                self._split_finder_cache = False
+        return self._split_finder_cache or None
 
     @property
     def num_trees(self) -> int:
@@ -136,11 +153,13 @@ class GBTree:
                         jnp.ones(binned.shape[0], jnp.bool_)
                     tree, row_leaf, d = grow_tree_dp(
                         mesh, tkey, binned, gh[:, k, :], self.cut_values_dev,
-                        self.n_cuts_dev, self.cfg, rv)
+                        self.n_cuts_dev, self.cfg, rv,
+                        split_finder=self._split_finder())
                 else:
                     tree, row_leaf = grow_tree(
                         tkey, binned, gh[:, k, :], self.cut_values_dev,
-                        self.n_cuts_dev, self.cfg, row_valid)
+                        self.n_cuts_dev, self.cfg, row_valid,
+                        split_finder=self._split_finder())
                     d = None
                 if do_prune:
                     tree, resolve = prune_tree(tree, self.param.gamma)
@@ -192,12 +211,14 @@ class GBTree:
             def one(tkey, gh2):
                 return grow_tree_dp(mesh, tkey, binned, gh2,
                                     self.cut_values_dev, self.n_cuts_dev,
-                                    self.cfg, rv)
+                                    self.cfg, rv,
+                                    split_finder=self._split_finder())
             stacked, row_leafs, ds = jax.vmap(one)(keys, gh_t)
         else:
             def one(tkey, gh2):
                 return grow_tree(tkey, binned, gh2, self.cut_values_dev,
-                                 self.n_cuts_dev, self.cfg, row_valid)
+                                 self.n_cuts_dev, self.cfg, row_valid,
+                                 split_finder=self._split_finder())
             stacked, row_leafs = jax.vmap(one)(keys, gh_t)
             ds = None
 

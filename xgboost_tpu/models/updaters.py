@@ -8,8 +8,10 @@ The reference exposes seven pluggable ``IUpdater`` names
     sorted-column scan of ``updater_colmaker-inl.hpp:362-414``).
   - ``grow_histmaker`` — quantile-binned histogram growth (the default;
     ``updater_histmaker-inl.hpp``).
-  - ``grow_skmaker``   — per-node sketch approximation; subsumed by the
-    histogram path here (same approximation family).
+  - ``grow_skmaker``   — per-node 3-way (pos-grad/neg-grad/hess)
+    quantile-sketch split selection (:mod:`xgboost_tpu.models.skmaker`;
+    ``updater_skmaker-inl.hpp:133-374``), plugged into the grower's
+    split_finder seam; classically paired with ``refresh``.
   - ``prune``          — bottom-up post-prune of splits with
     loss_chg < min_split_loss (``updater_prune-inl.hpp:42-72``).
   - ``refresh``        — recompute node stats/leaf values by streaming

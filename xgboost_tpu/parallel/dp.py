@@ -28,7 +28,7 @@ def _psum_data(x):
 
 
 def grow_tree_dp(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
-                 cfg: GrowConfig, row_valid):
+                 cfg: GrowConfig, row_valid, split_finder=None):
     """Grow one tree with rows sharded over mesh axis 'data'.
 
     binned: (N, F) with N divisible by mesh size; gh: (N, 2);
@@ -37,7 +37,8 @@ def grow_tree_dp(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
     """
     def body(key, binned, gh, cut_values, n_cuts, row_valid):
         tree, row_leaf = grow_tree(key, binned, gh, cut_values, n_cuts, cfg,
-                                   row_valid, hist_reduce=_psum_data)
+                                   row_valid, hist_reduce=_psum_data,
+                                   split_finder=split_finder)
         # leaf-value gather stays inside the shard: indices are shard-local
         return tree, row_leaf, tree.leaf_value[row_leaf]
 
