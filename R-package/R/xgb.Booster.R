@@ -21,8 +21,9 @@ xgb.train <- function(params = list(), data, nrounds,
                       early_stopping_rounds = NULL, maximize = NULL,
                       verbose = 1, ...) {
   stopifnot(inherits(data, "xgb.DMatrix"))
-  if (length(watchlist) > 0 && is.null(names(watchlist)))
-    stop("watchlist must be a NAMED list, e.g. list(train = dtrain)")
+  if (length(watchlist) > 0 &&
+      (is.null(names(watchlist)) || any(names(watchlist) == "")))
+    stop("every watchlist entry must be named, e.g. list(train = dtrain)")
   core <- .core()
   evals <- lapply(names(watchlist), function(n) {
     reticulate::tuple(watchlist[[n]]$handle, n)

@@ -1,0 +1,12 @@
+#!/bin/sh
+# CLI end-to-end on agaricus (reference demo/binary_classification/runexp.sh)
+set -e
+cd "$(dirname "$0")"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export PYTHONPATH="$(cd ../.. && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+python -m xgboost_tpu mushroom.conf model_out=./0002.model
+python -m xgboost_tpu mushroom.conf task=pred model_in=./0002.model name_pred=pred.txt
+python -m xgboost_tpu mushroom.conf task=dump model_in=./0002.model name_dump=dump.raw.txt
+head -3 dump.raw.txt
+rm -f 0002.model pred.txt dump.raw.txt
+echo "runexp ok"

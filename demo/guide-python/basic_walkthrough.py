@@ -1,0 +1,45 @@
+"""Basic walkthrough (reference demo/guide-python/basic_walkthrough.py):
+DMatrix from libsvm file / numpy / scipy, train with a watchlist,
+predict, save/load models and binary DMatrix caches."""
+import os
+import tempfile
+
+import numpy as np
+
+import xgboost_tpu as xgb
+
+DATA = os.environ.get("XGBTPU_DEMO_DATA",
+                      "/root/reference/demo/data")
+
+dtrain = xgb.DMatrix(f"{DATA}/agaricus.txt.train")
+dtest = xgb.DMatrix(f"{DATA}/agaricus.txt.test", num_col=dtrain.num_col)
+
+param = {"max_depth": 2, "eta": 1, "objective": "binary:logistic"}
+watchlist = [(dtest, "eval"), (dtrain, "train")]
+bst = xgb.train(param, dtrain, num_boost_round=2, evals=watchlist)
+
+preds = bst.predict(dtest)
+labels = dtest.get_label()
+err = sum(1 for i in range(len(preds))
+          if int(preds[i] > 0.5) != labels[i]) / float(len(preds))
+print(f"error={err:.6f}")
+
+with tempfile.TemporaryDirectory() as d:
+    # model save/load
+    bst.save_model(f"{d}/0001.model")
+    bst2 = xgb.Booster(model_file=f"{d}/0001.model")
+    assert np.allclose(np.asarray(bst2.predict(dtest)), np.asarray(preds))
+    # text dump with feature map
+    bst.dump_model(f"{d}/dump.raw.txt")
+    # binary DMatrix cache
+    dtest.save_binary(f"{d}/dtest.buffer")
+    dtest2 = xgb.DMatrix(f"{d}/dtest.buffer")
+    assert np.allclose(np.asarray(bst.predict(dtest2)), np.asarray(preds))
+
+# numpy interface
+rng = np.random.RandomState(1994)
+data = rng.randn(100, 10).astype(np.float32)
+label = rng.randint(2, size=100).astype(np.float32)
+dtrain_np = xgb.DMatrix(data, label=label)
+xgb.train(param, dtrain_np, 2)
+print("basic_walkthrough ok")

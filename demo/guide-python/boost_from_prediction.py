@@ -1,0 +1,21 @@
+"""Boost from an existing prediction via base_margin (reference
+boost_from_prediction.py)."""
+import os
+
+import xgboost_tpu as xgb
+
+DATA = os.environ.get("XGBTPU_DEMO_DATA", "/root/reference/demo/data")
+dtrain = xgb.DMatrix(f"{DATA}/agaricus.txt.train")
+dtest = xgb.DMatrix(f"{DATA}/agaricus.txt.test", num_col=dtrain.num_col)
+param = {"max_depth": 2, "eta": 1, "objective": "binary:logistic"}
+watchlist = [(dtest, "eval"), (dtrain, "train")]
+
+bst = xgb.train(param, dtrain, 1, evals=watchlist)
+# margin (not transformed probability) seeds the continued model
+ptrain = bst.predict(dtrain, output_margin=True)
+ptest = bst.predict(dtest, output_margin=True)
+dtrain.set_base_margin(ptrain)
+dtest.set_base_margin(ptest)
+print("this is result of running from initial prediction")
+bst2 = xgb.train(param, dtrain, 1, evals=watchlist)
+print("boost_from_prediction ok")

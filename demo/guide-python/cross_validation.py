@@ -1,0 +1,12 @@
+"""k-fold cross validation (reference cross_validation.py)."""
+import os
+
+import xgboost_tpu as xgb
+
+DATA = os.environ.get("XGBTPU_DEMO_DATA", "/root/reference/demo/data")
+dtrain = xgb.DMatrix(f"{DATA}/agaricus.txt.train")
+param = {"max_depth": 2, "eta": 1, "objective": "binary:logistic"}
+for line in xgb.cv(param, dtrain, num_boost_round=3, nfold=5,
+                   metrics=["error"], seed=0):
+    print(line)
+print("cross_validation ok")

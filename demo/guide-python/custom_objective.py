@@ -1,0 +1,30 @@
+"""Custom objective + custom metric (reference custom_objective.py):
+user-supplied grad/hess through Booster.boost and feval."""
+import os
+
+import numpy as np
+
+import xgboost_tpu as xgb
+
+DATA = os.environ.get("XGBTPU_DEMO_DATA", "/root/reference/demo/data")
+dtrain = xgb.DMatrix(f"{DATA}/agaricus.txt.train")
+dtest = xgb.DMatrix(f"{DATA}/agaricus.txt.test", num_col=dtrain.num_col)
+param = {"max_depth": 2, "eta": 1}
+
+
+def logregobj(preds, dtrain):
+    labels = dtrain.get_label()
+    preds = 1.0 / (1.0 + np.exp(-preds))
+    grad = preds - labels
+    hess = preds * (1.0 - preds)
+    return grad, hess
+
+
+def evalerror(preds, dtrain):
+    labels = dtrain.get_label()
+    return "error", float(np.mean((preds > 0.0) != labels))
+
+
+bst = xgb.train(param, dtrain, 2, evals=[(dtest, "eval"), (dtrain, "train")],
+                obj=logregobj, feval=evalerror)
+print("custom_objective ok")

@@ -1,0 +1,15 @@
+"""Out-of-core training from a paged matrix (reference external_memory.py:
+the #cachefile convention)."""
+import os
+import tempfile
+
+import xgboost_tpu as xgb
+from xgboost_tpu.external import ExtMemDMatrix
+
+DATA = os.environ.get("XGBTPU_DEMO_DATA", "/root/reference/demo/data")
+with tempfile.TemporaryDirectory() as d:
+    dtrain = ExtMemDMatrix(f"{DATA}/agaricus.txt.train",
+                           cache=f"{d}/dtrain.cache")
+    param = {"max_depth": 2, "eta": 1, "objective": "binary:logistic"}
+    bst = xgb.train(param, dtrain, 2, evals=[(dtrain, "train")])
+print("external_memory ok")

@@ -1,0 +1,12 @@
+"""GBLinear booster (reference generalized_linear_model.py)."""
+import os
+
+import xgboost_tpu as xgb
+
+DATA = os.environ.get("XGBTPU_DEMO_DATA", "/root/reference/demo/data")
+dtrain = xgb.DMatrix(f"{DATA}/agaricus.txt.train")
+dtest = xgb.DMatrix(f"{DATA}/agaricus.txt.test", num_col=dtrain.num_col)
+param = {"booster": "gblinear", "objective": "binary:logistic",
+         "alpha": 0.0001, "lambda": 1}
+bst = xgb.train(param, dtrain, 4, evals=[(dtest, "eval"), (dtrain, "train")])
+print("generalized_linear_model ok")

@@ -1,0 +1,19 @@
+"""Predict with only the first N trees (reference predict_first_ntree.py)."""
+import os
+
+import numpy as np
+
+import xgboost_tpu as xgb
+
+DATA = os.environ.get("XGBTPU_DEMO_DATA", "/root/reference/demo/data")
+dtrain = xgb.DMatrix(f"{DATA}/agaricus.txt.train")
+dtest = xgb.DMatrix(f"{DATA}/agaricus.txt.test", num_col=dtrain.num_col)
+param = {"max_depth": 2, "eta": 1, "objective": "binary:logistic"}
+bst = xgb.train(param, dtrain, 3, evals=[(dtest, "eval")])
+label = dtest.get_label()
+p1 = bst.predict(dtest, ntree_limit=1)
+pall = bst.predict(dtest)
+print("error of ntree=1:", float(np.mean((np.asarray(p1) > 0.5) != label)))
+print("error of all trees:",
+      float(np.mean((np.asarray(pall) > 0.5) != label)))
+print("predict_first_ntree ok")

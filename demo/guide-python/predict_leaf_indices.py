@@ -1,0 +1,14 @@
+"""Per-tree leaf index prediction (reference predict_leaf_indices.py)."""
+import os
+
+import xgboost_tpu as xgb
+
+DATA = os.environ.get("XGBTPU_DEMO_DATA", "/root/reference/demo/data")
+dtrain = xgb.DMatrix(f"{DATA}/agaricus.txt.train")
+dtest = xgb.DMatrix(f"{DATA}/agaricus.txt.test", num_col=dtrain.num_col)
+bst = xgb.train({"max_depth": 2, "eta": 1,
+                 "objective": "binary:logistic"}, dtrain, 3)
+leaves = bst.predict(dtest, pred_leaf=True)
+print("leaf index shape:", leaves.shape)
+print(leaves[:5])
+print("predict_leaf_indices ok")

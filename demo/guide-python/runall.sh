@@ -1,0 +1,12 @@
+#!/bin/sh
+# run every walkthrough (reference demo/guide-python/runall.sh)
+set -e
+cd "$(dirname "$0")"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export PYTHONPATH="$(cd ../.. && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+for f in basic_walkthrough custom_objective boost_from_prediction \
+         cross_validation predict_first_ntree predict_leaf_indices \
+         generalized_linear_model external_memory sklearn_examples; do
+  echo "== $f =="
+  python "$f.py"
+done

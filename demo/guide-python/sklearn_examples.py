@@ -1,0 +1,15 @@
+"""sklearn-style estimator API (reference sklearn_examples.py)."""
+import numpy as np
+
+from xgboost_tpu.sklearn import XGBClassifier, XGBRegressor
+
+rng = np.random.RandomState(1994)
+X = rng.rand(200, 10).astype(np.float32)
+y = (X[:, 0] + X[:, 1] > 1.0).astype(int)
+clf = XGBClassifier(n_estimators=4, max_depth=3).fit(X, y)
+print("classifier acc:", float((clf.predict(X) == y).mean()))
+
+yr = X[:, 0] * 2 + rng.randn(200) * 0.1
+reg = XGBRegressor(n_estimators=4, max_depth=3).fit(X, yr)
+print("regressor mse:", float(((reg.predict(X) - yr) ** 2).mean()))
+print("sklearn_examples ok")

@@ -250,7 +250,10 @@ class DMatrix:
                 fields["meta_" + f] = v
         if self.info.group_ptr is not None:
             fields["meta_group_ptr"] = self.info.group_ptr
-        np.savez(path, **fields)
+        # write through a file object: np.savez(str) appends ".npz",
+        # which would break the reference's name.buffer convention
+        with open(path, "wb") as f:
+            np.savez(f, **fields)
 
     @classmethod
     def load_binary(cls, path: str) -> "DMatrix":

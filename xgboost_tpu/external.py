@@ -334,7 +334,8 @@ def _paged_level_hist_dp(mesh, tree: TreeArrays, binned: jax.Array,
 
 def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
                     cut_values: jax.Array, n_cuts: jax.Array,
-                    cfg: GrowConfig, mesh=None) -> TreeArrays:
+                    cfg: GrowConfig, mesh=None,
+                    split_finder=None) -> TreeArrays:
     """Level-by-level growth streaming binned batches host→device.
 
     With ``mesh``, each batch's rows shard over the 'data' axis and
@@ -348,6 +349,9 @@ def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
     """
     from xgboost_tpu.models.tree import (_default_split_finder,
                                          _sample_features)
+
+    if split_finder is None:
+        split_finder = _default_split_finder
 
     key_rows, key_ftree, key_flevel = jax.random.split(key, 3)
     gh_used = gh
@@ -389,8 +393,8 @@ def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
                 fmask = fmask & _sample_features(
                     jax.random.fold_in(key_flevel, depth), F,
                     cfg.colsample_bylevel)
-            best = _default_split_finder(hist, nst, n_cuts, cut_values,
-                                         fmask, cfg.split)
+            best = split_finder(hist, nst, n_cuts, cut_values,
+                                fmask, cfg.split)
             can_try = nst[:, 1] >= 2.0 * cfg.split.min_child_weight
             make_leaf = ~(best.valid & can_try)
         tree = apply_level(tree, depth, nst, best, make_leaf, cfg.split)

@@ -34,6 +34,13 @@ def load_dmatrix_into(dmat, uri: str, silent: bool = True,
     if path.endswith(".npz") and os.path.exists(path):
         _copy_from(dmat, _load_npz(path))
         return
+    # magic sniffing regardless of suffix (the reference's .buffer
+    # convention, io.cpp:36-45): a saved binary cache is a zip container
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            if f.read(4) == b"PK\x03\x04":
+                _copy_from(dmat, _load_npz(path))
+                return
 
     indptr, indices, values, labels = parse_libsvm(path, rank, nparts)
     dmat.indptr, dmat.indices, dmat.values = indptr, indices, values

@@ -93,6 +93,8 @@ class Booster:
             self.gbtree.param = self.param
             self.gbtree.cfg = make_grow_config(self.param,
                                                self.gbtree.cuts.max_bin)
+            # updater / sketch params may have changed the split finder
+            self.gbtree._split_finder_cache = None
 
     # ------------------------------------------------------------- init
     def _lazy_init(self, dtrain: DMatrix):

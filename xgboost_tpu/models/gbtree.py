@@ -137,6 +137,11 @@ class GBTree:
                 mock.collective()
                 tkey = jax.random.fold_in(key, k * npar + t)
                 if col_mesh is not None:
+                    if self._split_finder() is not None:
+                        raise NotImplementedError(
+                            "updater=grow_skmaker is not supported under "
+                            "dsplit=col (the column-split grower reduces "
+                            "SplitEntry tuples, not summaries)")
                     from xgboost_tpu.parallel.colsplit import (
                         grow_tree_colsplit, pad_features)
                     n_shard = col_mesh.devices.size
@@ -265,7 +270,8 @@ class GBTree:
                 tkey = jax.random.fold_in(key, k * npar + t)
                 tree = grow_tree_paged(tkey, dmat, gh[:, k, :],
                                        self.cut_values_dev, self.n_cuts_dev,
-                                       self.cfg, mesh=mesh)
+                                       self.cfg, mesh=mesh,
+                                       split_finder=self._split_finder())
                 if do_prune:
                     tree, _ = prune_tree(tree, self.param.gamma)
                 for start, batch in dmat.binned_batches():

@@ -70,7 +70,7 @@ def _compress_row(mass: jax.Array, K: int):
     return sv[order2], sr[order2], total
 
 
-def _rank_at(values: jax.Array, rank_next: jax.Array, total, q: jax.Array):
+def _rank_at(values: jax.Array, rank_next: jax.Array, q: jax.Array):
     """Mass <= q from a compressed summary (conservative: the last
     retained entry at or below q)."""
     idx = jnp.searchsorted(values, q, side="right") - 1
@@ -103,15 +103,15 @@ def skmaker_split_finder(K: int):
         # exclude the missing bin 0 as a boundary by flooring at bin 1
         cand = jnp.clip(hv, 1.0, float(B))        # (M, F, K)
 
-        def left_mass(vals, ranks, tot, c):
-            le = _rank_at(vals, ranks, tot, c)    # mass <= c incl. bin 0
-            at0 = _rank_at(vals, ranks, tot, jnp.float32(0.0))
+        def left_mass(vals, ranks, c):
+            le = _rank_at(vals, ranks, c)         # mass <= c incl. bin 0
+            at0 = _rank_at(vals, ranks, jnp.float32(0.0))
             return le - at0                       # exclude missing mass
 
         q = jax.vmap(jax.vmap(jax.vmap(
             lambda c, pvv, prr, nvv, nrr, hvv, hrr: (
-                left_mass(pvv, prr, None, c) - left_mass(nvv, nrr, None, c),
-                left_mass(hvv, hrr, None, c)),
+                left_mass(pvv, prr, c) - left_mass(nvv, nrr, c),
+                left_mass(hvv, hrr, c)),
             in_axes=(0, None, None, None, None, None, None))))
         GL_excl, HL_excl = q(cand, pv, pr, nv, nr, hv, hr)  # (M, F, K)
 
@@ -169,4 +169,4 @@ def skmaker_split_finder(K: int):
 def _rank_at_batch(vals, ranks, q):
     """(M, F, K) summaries queried at scalar q -> (M, F)."""
     return jax.vmap(jax.vmap(
-        lambda v, r: _rank_at(v, r, None, jnp.float32(q))))(vals, ranks)
+        lambda v, r: _rank_at(v, r, jnp.float32(q))))(vals, ranks)
