@@ -248,3 +248,22 @@ def test_vmapped_ensemble_bit_matches_sequential_dp(monkeypatch):
     s_seq, s_vm = b_seq.gbtree.get_state(), b_vm.gbtree.get_state()
     for k in s_seq:
         np.testing.assert_array_equal(s_seq[k], s_vm[k], err_msg=k)
+
+
+def test_gblinear_converges_on_correlated_features():
+    """Round-1 verdict weak item 7: fully-parallel Jacobi diverges on
+    strongly correlated features; the block-sequential CD (default
+    linear_block=1) must converge even on perfectly duplicated columns."""
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(0)
+    base = rng.rand(500, 1).astype(np.float32)
+    X = np.repeat(base, 16, axis=1)  # 16 identical columns
+    y = (2.0 * base[:, 0] + 0.1 * rng.randn(500)).astype(np.float32)
+    res = {}
+    xgb.train({"booster": "gblinear", "objective": "reg:linear",
+               "eta": 0.5, "lambda": 1.0}, xgb.DMatrix(X, label=y), 30,
+              evals=[(xgb.DMatrix(X, label=y), "train")],
+              evals_result=res, verbose_eval=False)
+    r = [float(v) for v in res["train-rmse"]]
+    assert np.isfinite(r[-1]) and r[-1] < r[0] and r[-1] < 0.15, r[-1]

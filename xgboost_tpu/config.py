@@ -58,6 +58,10 @@ class TrainParam:
     # mesh axis (parallel/sketch_device.py — rabit SerializeReducer analog,
     # histmaker-inl.hpp:417-424).  0 = host-side global sketch.
     device_sketch: int = 0
+    # gblinear coordinate-descent block size: 1 = exact sequential CD
+    # (convergent under feature correlation); >1 = shotgun-style parallel
+    # updates within each block (reference gblinear-inl.hpp:76-105)
+    linear_block: int = 1
 
     # -- gbtree params (reference src/gbm/gbtree-inl.hpp:389-428) --
     num_parallel_tree: int = 1
