@@ -60,11 +60,20 @@ def main():
     # round 0 pays jit compilation; time steady-state rounds
     bst.update(dtrain, 0)
     import jax
-    jax.block_until_ready(bst._cache[id(dtrain)].margin)
+
+    def barrier():
+        # block_until_ready is advisory on remote-attached backends
+        # (see PROFILE.md); a one-element host pull is a true barrier
+        # on the in-order stream
+        m = bst._cache[id(dtrain)].margin
+        jax.block_until_ready(m)
+        jax.device_get(m.ravel()[:1])
+
+    barrier()
     t0 = time.perf_counter()
     for i in range(1, n_rounds):
         bst.update(dtrain, i)
-    jax.block_until_ready(bst._cache[id(dtrain)].margin)
+    barrier()
     dt = time.perf_counter() - t0
 
     rounds_per_sec = (n_rounds - 1) / dt
