@@ -1,0 +1,46 @@
+"""Worker for test_launch.py: FULL Booster training across processes.
+
+Each process holds the replicated host copy of the data; compute shards
+over the global (cross-process) mesh.  Writes the final model + eval
+line per rank so the test can assert cross-rank identity and quality.
+Usage: mp_train_worker.py <libsvm_path> <out_prefix>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from xgboost_tpu.parallel.launch import init_worker  # noqa: E402
+
+assert init_worker(local_device_count=2)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    path, out_prefix = sys.argv[1], sys.argv[2]
+    rank = jax.process_index()
+    assert jax.device_count() == 4
+
+    import xgboost_tpu as xgb
+
+    dtrain = xgb.DMatrix(path)
+    res = {}
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "eta": 0.7, "max_bin": 32, "dsplit": "row"},
+                    dtrain, 5, evals=[(dtrain, "train")],
+                    evals_result=res, verbose_eval=False)
+    err = float(res["train-error"][-1])
+    bst.save_model(f"{out_prefix}.rank{rank}.model")
+    with open(f"{out_prefix}.rank{rank}.err", "w") as f:
+        f.write(f"{err:.6f}\n")
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -81,3 +81,38 @@ def test_launcher_keepalive_restarts(tmp_path):
     from xgboost_tpu.parallel.launch import launch_local
     rc = launch_local(1, [sys.executable, str(script)], keepalive=True)
     assert rc == 0
+
+
+def test_two_process_full_booster_training(tmp_path):
+    """FULL Booster training across 2 processes x 2 devices: both ranks
+    must produce byte-identical models with good training error, and the
+    model must be loadable for local prediction."""
+    data = tmp_path / "train.libsvm"
+    rng = np.random.RandomState(4)
+    X = rng.rand(800, 6)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0.7).astype(int)
+    with open(data, "w") as fh:
+        for i in range(800):
+            feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(6))
+            fh.write(f"{y[i]} {feats}\n")
+
+    out = tmp_path / "mp"
+    cmd = [sys.executable, "-m", "xgboost_tpu.launch", "-n", "2",
+           "--local-devices", "2", "--",
+           sys.executable, os.path.join(REPO, "tests", "mp_train_worker.py"),
+           str(data), str(out)]
+    r = subprocess.run(cmd, cwd=REPO, env=_clean_env(),
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+
+    m0 = (tmp_path / "mp.rank0.model").read_bytes()
+    m1 = (tmp_path / "mp.rank1.model").read_bytes()
+    assert m0 == m1, "ranks diverged"
+    err = float((tmp_path / "mp.rank0.err").read_text())
+    assert err < 0.05, err
+
+    # the multi-process model predicts locally like any other model
+    import xgboost_tpu as xgb
+    bst = xgb.Booster(model_file=str(tmp_path / "mp.rank0.model"))
+    p = np.asarray(bst.predict(xgb.DMatrix(str(data))))
+    assert float(np.mean((p > 0.5) != y)) < 0.05

@@ -54,6 +54,8 @@ class BoostLearnTask:
         self.save_base64 = 0  # text-safe model files (reference bs64 mode)
         self.mock_spec: List[Tuple[int, int, int]] = []  # fault injection
         self.keepalive = 0  # restart-on-WorkerFailure (rabit_demo keepalive)
+        self.rank = 0  # process index under multi-host launch
+        self._distributed = False
         self.eval_names: List[str] = []
         self.eval_paths: List[str] = []
         self.learner_params: List[Tuple[str, str]] = []
@@ -135,12 +137,34 @@ class BoostLearnTask:
             self.set_param("silent", "1")
             self.save_period = 0
 
+        # multi-host worker mode (launched by xgboost_tpu.launch or a
+        # scheduler exporting XGBTPU_COORD): initialize the distributed
+        # runtime BEFORE any backend use, train dsplit=row over the
+        # global mesh, auto-silence nonzero ranks and save from rank 0
+        # only (reference xgboost_main.cpp:48-50, :242-245)
+        from xgboost_tpu.parallel.launch import init_worker
+        self._distributed = init_worker()
+        if self._distributed:
+            import jax
+            self.rank = jax.process_index()
+            if not any(k == "dsplit" for k, _ in self.learner_params):
+                self.set_param("dsplit", "row")
+            if self.rank != 0:
+                self.silent = max(self.silent, 2)
+                if self.task != "train":
+                    # pred/eval/dump are process-local: one rank suffices
+                    # (and concurrent writes to shared output would race)
+                    return 0
+
         if self.task == "train":
             if not self.mock_spec:
                 return self.task_train()
             # fault-injection mode: install the injector; with keepalive,
             # restart from the checkpoint ring on simulated death (the
-            # rabit_demo.py:26-40 keepalive wrapper, in-process)
+            # rabit_demo.py:26-40 keepalive wrapper, in-process).  In a
+            # multi-host job the gang launcher owns restarts (a single
+            # process cannot rejoin a live jax.distributed job), so the
+            # failure propagates as a nonzero exit instead.
             from xgboost_tpu.parallel import mock
             trial = int(os.environ.get("XGBTPU_NUM_TRIAL", "0"))
             while True:
@@ -148,10 +172,11 @@ class BoostLearnTask:
                 try:
                     return self.task_train()
                 except mock.WorkerFailure as e:
+                    restart = self.keepalive and not self._distributed
                     print(f"{e}; "  # message carries the [mock] tag
-                          + ("restarting" if self.keepalive else "dead"),
+                          + ("restarting" if restart else "dead"),
                           file=sys.stderr)
-                    if not self.keepalive:
+                    if not restart:
                         raise
                     trial += 1
                 finally:
@@ -195,6 +220,8 @@ class BoostLearnTask:
         return bst
 
     def _save(self, bst, i: Optional[int] = None) -> None:
+        if self.rank != 0:  # rank-0-only saves (xgboost_main.cpp:242-245)
+            return
         if i is None:
             assert self.model_out is not None
             path = self.model_out
@@ -215,8 +242,17 @@ class BoostLearnTask:
         bst = self._make_booster(cache=[data] + [d for d, _ in evals])
         start_round = 0
         if self.checkpoint_dir:
-            bst, start_round = _load_checkpoint(
-                self.checkpoint_dir, bst, self._params_dict())
+            if self._distributed and self.rank != 0:
+                pass  # rank 0's checkpoint is broadcast below
+            else:
+                bst, start_round = _load_checkpoint(
+                    self.checkpoint_dir, bst, self._params_dict())
+            if self._distributed:
+                # rabit::LoadCheckPoint semantics: the recovered state is
+                # broadcast so every rank resumes at the same round even
+                # without a shared checkpoint filesystem
+                bst, start_round = _broadcast_checkpoint(
+                    bst, start_round, self.rank, self._params_dict())
 
         start = time.time()
         for i in range(start_round, self.num_round):
@@ -233,7 +269,7 @@ class BoostLearnTask:
                     print(msg, file=sys.stderr)
             if self.save_period != 0 and (i + 1) % self.save_period == 0:
                 self._save(bst, i)
-            if self.checkpoint_dir:
+            if self.checkpoint_dir and self.rank == 0:
                 _save_checkpoint(self.checkpoint_dir, bst, i + 1)
         # save final round unless a periodic numbered save already covered
         # it (reference xgboost_main.cpp:219-225: no final save when
@@ -326,6 +362,28 @@ def _load_checkpoint(ckpt_dir: str, bst, params: dict):
     bst.load_model(os.path.join(ckpt_dir, found[-1]))
     bst.set_param(params)
     return bst, version
+
+
+def _broadcast_checkpoint(bst, start_round: int, rank: int, params: dict):
+    """Broadcast rank 0's recovered model + round to every rank
+    (rabit::LoadCheckPoint, subtree/rabit/include/rabit.h:166-186)."""
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    raw = bst.save_raw() if (rank == 0 and start_round > 0) else b""
+    hdr = mhu.broadcast_one_to_all(
+        np.array([len(raw), start_round], np.int64))
+    n, rounds = int(hdr[0]), int(hdr[1])
+    if n == 0:
+        return bst, 0
+    buf = np.zeros(n, np.uint8)
+    if rank == 0:
+        buf[:] = np.frombuffer(raw, np.uint8)
+    buf = mhu.broadcast_one_to_all(buf)
+    if rank != 0:
+        bst.load_raw(buf.tobytes())
+        bst.set_param(params)
+    return bst, rounds
 
 
 def main(argv: Optional[List[str]] = None) -> int:

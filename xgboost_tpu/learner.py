@@ -225,17 +225,42 @@ class Booster:
         binned_np = bin_matrix(dmat, self.gbtree.cuts)
         if pad:
             binned_np = np.pad(binned_np, ((0, pad), (0, 0)))
-        binned = shard_rows(self._mesh, jnp.asarray(binned_np))
-        row_valid = shard_rows(self._mesh, jnp.asarray(
-            np.arange(n + pad) < n))
+        # host numpy -> global sharding directly: in multi-process mode
+        # every process holds the full (replicated) host copy and
+        # device_put places only its addressable shards
+        binned = shard_rows(self._mesh, binned_np)
+        row_valid = shard_rows(self._mesh, np.arange(n + pad) < n)
         info = _pad_info(dmat.info, n, pad, self._K)
+        # device-resident SHARDED gradient inputs (row-aligned with the
+        # margin); also avoids re-uploading label/weight every round
+        if info.label is not None:
+            info._dev_cache["label"] = shard_rows(
+                self._mesh, np.asarray(info.label, np.float32))
+        info._dev_cache[("weight", n + pad)] = shard_rows(
+            self._mesh, np.asarray(info.get_weight(n + pad), np.float32))
         base = np.broadcast_to(
             np.asarray(self._base_margin_of(dmat, n)), (n, self._K))
         base = np.concatenate(
             [base, np.zeros((pad, self._K), np.float32)]) if pad else base
-        base = shard_rows(self._mesh, jnp.asarray(base, jnp.float32))
+        base = shard_rows(self._mesh, np.asarray(base, np.float32))
         return _CacheEntry(dmat, binned, base, info=info,
                            row_valid=row_valid, n_real=n)
+
+    def _replicated(self, x):
+        """Make a device value fully addressable for host pulls: in
+        multi-process mode sharded arrays live partly on other hosts, so
+        metric evaluation / prediction output all-gathers them first
+        (rides ICI on real pods; the reference instead allreduces metric
+        partial sums — same communication role)."""
+        if (isinstance(x, jax.Array) and not x.is_fully_addressable
+                and self._mesh is not None):
+            if getattr(self, "_replicate_fn", None) is None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                self._replicate_fn = jax.jit(
+                    lambda v: v,
+                    out_shardings=NamedSharding(self._mesh, P()))
+            x = self._replicate_fn(x)
+        return x
 
     def _sync_margin(self, entry: _CacheEntry):
         """Fold not-yet-applied trees into the cached margin, one round's
@@ -320,15 +345,21 @@ class Booster:
                 p.block(entry.margin)
         if fobj is None:
             with ph("gradient") as p:
+                margin = entry.margin
+                if getattr(self.obj, "needs_host_margin", False):
+                    # ranking objectives sample pairs host-side from the
+                    # full margin; all-gather it in multi-process mode
+                    margin = self._replicated(margin)
                 gh = self.obj.get_gradient(
-                    jnp.asarray(entry.margin), entry.info,
+                    jnp.asarray(margin), entry.info,
                     iteration, entry.margin.shape[0])
                 if prof:
                     p.block(gh)
         else:
             # custom objective sees only the real rows; gradients are
             # zero-padded back to the device row count below in boost()
-            pred = np.asarray(self.obj.pred_transform(entry.margin))
+            pred = np.asarray(self._replicated(
+                self.obj.pred_transform(entry.margin)))
             pred = pred[:entry.n_real]
             if pred.shape[1] == 1:
                 pred = pred[:, 0]
@@ -463,7 +494,8 @@ class Booster:
         else:
             binned, base = cached.binned, cached.base
         if pred_leaf:
-            leaves = np.asarray(self.gbtree.predict_leaf(binned, ntree_limit))
+            leaves = np.asarray(self._replicated(
+                self.gbtree.predict_leaf(binned, ntree_limit)))
             return leaves[:cached.n_real] if cached is not None else leaves
         if cached is not None and ntree_limit == 0:
             self._sync_margin(cached)
@@ -471,7 +503,7 @@ class Booster:
         else:
             margin = self.gbtree.predict_margin(binned, base, ntree_limit)
         out = self.obj.pred_transform(margin, output_margin=output_margin)
-        out = np.asarray(out)
+        out = np.asarray(self._replicated(out))
         if cached is not None:
             out = out[:cached.n_real]
         if out.ndim == 2 and out.shape[1] == 1:
@@ -493,7 +525,8 @@ class Booster:
         for dmat, name in evals:
             entry = self._entry(dmat)
             self._sync_margin(entry)
-            tr = np.asarray(self.obj.eval_transform(entry.margin))[:entry.n_real]
+            tr = np.asarray(self._replicated(
+                self.obj.eval_transform(entry.margin)))[:entry.n_real]
             labels = np.asarray(dmat.get_label())
             weights = np.asarray(dmat.get_weight())
             gptr = dmat.info.group_ptr
@@ -644,7 +677,14 @@ def _pad_info(info: MetaInfo, n: int, pad: int, k: int = 1) -> MetaInfo:
     gradients (group_ptr is left untouched: rows past gptr[-1] are
     group-less and get no ranking pairs)."""
     if pad == 0:
-        return info
+        # still a fresh MetaInfo (sharing the arrays): the caller
+        # populates _dev_cache with mesh-sharded device arrays, which
+        # must not leak into the user's DMatrix
+        out = MetaInfo()
+        for f in ("label", "weight", "base_margin", "root_index",
+                  "fold_index", "group_ptr"):
+            setattr(out, f, getattr(info, f))
+        return out
     out = MetaInfo()
     if info.label is not None:
         out.label = np.concatenate(

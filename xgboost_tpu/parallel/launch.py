@@ -22,14 +22,14 @@ them up.  Workers load only their row shard (``parse_libsvm`` rank /
 nparts modulo split — reference ``simple_dmatrix-inl.hpp:89-96``) and
 assemble global arrays with ``jax.make_array_from_process_local_data``.
 
-What is multi-process capable today (tests/test_launch.py proves the
-2-process x 2-device path end to end): the launcher + ``init_worker``
-rendezvous, the global data-parallel mesh, and the distributed growth /
-sketch kernels (``parallel/dp.py``, ``parallel/sketch_device.py``).
-The high-level ``Booster`` convenience layer still assumes a single
-controller for metric evaluation and prediction pulls; a multi-process
-CLI training loop composes the pieces above the same way the worker in
-``tests/mp_grow_worker.py`` does.
+The FULL stack is multi-process capable (tests/test_launch.py proves
+2-process x 2-device jobs end to end): launcher + ``init_worker``
+rendezvous, the global data-parallel mesh, the distributed growth /
+sketch kernels, and the high-level ``Booster``/CLI training loop —
+each process holds the replicated host copy of the data, compute
+shards over the global mesh, host pulls (metrics/predictions)
+all-gather first (``Booster._replicated``), and ranks produce
+byte-identical models (rank 0 saves, like the reference).
 """
 
 from __future__ import annotations

@@ -25,6 +25,9 @@ _EPS = 1e-16
 
 
 class LambdaRankObj(Objective):
+    # pair sampling reads the full margin on the host each round
+    needs_host_margin = True
+
     default_metric = "map"
 
     def __init__(self, name: str):
