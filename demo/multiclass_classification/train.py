@@ -1,0 +1,29 @@
+"""Multiclass softmax demo (reference demo/multiclass_classification/
+train.py: dermatology, 6 classes): both multi:softmax (class ids) and
+multi:softprob (probability matrix)."""
+import numpy as np
+
+import xgboost_tpu as xgb
+
+rng = np.random.RandomState(7)
+n, n_class = 2000, 6
+centers = rng.randint(0, 4, size=(n_class, 34))
+y = rng.randint(0, n_class, size=n)
+X = np.clip(centers[y] + rng.randint(-1, 2, size=(n, 34)), 0, 3).astype(
+    np.float32)
+cut = int(n * 0.7)
+dtrain = xgb.DMatrix(X[:cut], label=y[:cut])
+dtest = xgb.DMatrix(X[cut:], label=y[cut:])
+params = {"objective": "multi:softmax", "num_class": n_class,
+          "max_depth": 6, "eta": 0.1}
+bst = xgb.train(params, dtrain, 5,
+                evals=[(dtrain, "train"), (dtest, "test")])
+pred = np.asarray(bst.predict(dtest))
+print("softmax test merror:", float(np.mean(pred != y[cut:])))
+
+params["objective"] = "multi:softprob"
+bst2 = xgb.train(params, dtrain, 5, verbose_eval=False)
+prob = np.asarray(bst2.predict(dtest))
+print("softprob shape:", prob.shape,
+      "merror:", float(np.mean(prob.argmax(axis=1) != y[cut:])))
+print("multiclass demo ok")

@@ -1,0 +1,18 @@
+"""Regression demo (reference demo/regression/): reg:linear on a
+synthetic machine-performance-like dataset, CLI-config style params."""
+import numpy as np
+
+import xgboost_tpu as xgb
+
+rng = np.random.RandomState(1)
+X = rng.rand(2000, 12).astype(np.float32)
+y = (3 * X[:, 0] - 2 * X[:, 1] * X[:, 2] + 0.5 * rng.randn(2000)).astype(
+    np.float32)
+dtrain = xgb.DMatrix(X[:1500], label=y[:1500])
+dtest = xgb.DMatrix(X[1500:], label=y[1500:])
+params = {"objective": "reg:linear", "eta": 0.3, "max_depth": 4,
+          "eval_metric": "rmse"}
+bst = xgb.train(params, dtrain, 30,
+                evals=[(dtrain, "train"), (dtest, "test")],
+                verbose_eval=10)
+print("regression demo ok")

@@ -97,3 +97,18 @@ def test_cli_save_period_and_checkpoint_resume(svm_data, tmp_path):
     import xgboost_tpu as xgb
     bst = xgb.Booster(model_file=str(tp / "resumed.model"))
     assert bst.gbtree.num_trees == 6
+
+
+def test_stdin_data_loading(monkeypatch):
+    """data=stdin (reference io.cpp:32-38, the Hadoop-streaming channel)."""
+    import io as _io
+    import sys
+
+    import xgboost_tpu as xgb
+
+    text = b"1 0:0.5 2:1.0\n0 1:0.25\n1 0:0.9\n"
+    monkeypatch.setattr(sys, "stdin",
+                        type("S", (), {"buffer": _io.BytesIO(text)})())
+    d = xgb.DMatrix("stdin")
+    assert d.num_row == 3 and d.num_col == 3
+    np.testing.assert_array_equal(d.get_label(), [1, 0, 1])

@@ -27,6 +27,25 @@ def load_dmatrix_into(dmat, uri: str, silent: bool = True,
     if nparts > 1 and cache:
         cache = f"{cache}.r{rank}-{nparts}"  # per-rank cache (io.cpp:56-61)
 
+    if path == "stdin":
+        # text-over-stdin loading (reference io.cpp:32-38 — the Hadoop
+        # streaming channel): spool to a temp file for the shared parser
+        import sys
+        import tempfile
+        with tempfile.NamedTemporaryFile("wb", suffix=".libsvm",
+                                         delete=False) as tf:
+            tf.write(sys.stdin.buffer.read())
+            spooled = tf.name
+        try:
+            indptr, indices, values, labels = parse_libsvm(
+                spooled, rank, nparts)
+        finally:
+            os.unlink(spooled)
+        dmat.indptr, dmat.indices, dmat.values = indptr, indices, values
+        dmat._num_col = int(indices.max()) + 1 if len(indices) else 0
+        dmat.info.set_field("label", labels)
+        return
+
     cache_file = cache + ".npz" if cache else None
     if cache_file and os.path.exists(cache_file):
         _copy_from(dmat, _load_npz(cache_file))
