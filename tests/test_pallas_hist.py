@@ -79,3 +79,17 @@ def test_stats_from_histogram_matches_node_stats():
     np.testing.assert_allclose(np.asarray(stats_from_histogram(hist)),
                                np.asarray(node_stats(gh, pos, 8)),
                                rtol=0, atol=1e-5)
+
+
+@pytest.mark.parametrize("N,F,B,M", [
+    (600, 34, 40, 64),    # f_tile < F with F not a multiple of 8
+    (600, 34, 256, 512),  # deep level forces f_tile rounding to 8s
+])
+def test_pallas_histogram_odd_feature_tiling(N, F, B, M):
+    """Block sublane dims must be multiples of 8 or the full feature dim
+    (regression: F=34 with a budget tile of 30 failed Mosaic lowering)."""
+    binned, gh, pos = _case(N, F, B, M, seed=11)
+    want = np.asarray(build_level_histogram(binned, gh, pos, M, B))
+    got = np.asarray(build_level_histogram_pallas(
+        binned, gh, pos, M, B, interpret=True))
+    np.testing.assert_array_equal(got, want)

@@ -108,6 +108,11 @@ def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
     # ~<=1MB of VMEM at any depth (2M lanes grow with the level)
     f_tile = max(1, min(F, (256 * 1024) // (max(n_bin, 1) *
                                             max(2 * n_node, 128))))
+    # TPU tile rule: a block's sublane dim must be a multiple of 8 OR
+    # equal the full array dim.  Tile in multiples of 8 when tiling at
+    # all; otherwise take the whole (un-padded) feature dim.
+    if f_tile < F:
+        f_tile = max(8, (f_tile // 8) * 8)
     n_pad = _round_up(max(N, 1), r_tile)
     f_pad = _round_up(F, f_tile)
     m_pad = n_node  # lanes pad to 128 inside the MXU anyway
