@@ -9,7 +9,8 @@ the reference's rabit TCP allreduce becomes ``psum`` over an ICI mesh.
 Design stance (see SURVEY.md §7): not a port.  Data is pre-binned into
 dense device arrays (uint8 bin ids) instead of CSR/CSC scans; trees are
 struct-of-arrays tensors grown level-by-level inside ``jit``; the one
-custom kernel is a Pallas histogram kernel; everything else is XLA.
+custom kernels are the Pallas histogram/node-stat kernels
+(:mod:`xgboost_tpu.ops.pallas_hist`); everything else is XLA.
 """
 
 from xgboost_tpu.config import TrainParam
