@@ -95,16 +95,19 @@ class BoostLearnTask:
             self.checkpoint_dir = val
         elif name == "mock":
             # reference AllreduceMock spec "rank,version,seqno,ntrial"
-            # (allreduce_mock.h:57-63); single-controller XLA training has
-            # no per-rank deaths, so a leading rank field is accepted and
-            # dropped.  Multiple coordinates: semicolon-separated.
+            # (allreduce_mock.h:57-63).  Stored with the rank; under the
+            # multi-host launcher only the matching worker installs the
+            # coordinate (single-controller: rank 0 == the process).
+            # 3-field specs apply to every rank.  Multiple coordinates:
+            # semicolon-separated.
             for part in val.split(";"):
                 nums = [int(x) for x in part.split(",") if x.strip() != ""]
-                if len(nums) == 4:
-                    nums = nums[1:]
-                if len(nums) != 3:
+                if len(nums) == 3:
+                    nums = [-1] + nums  # any rank
+                if len(nums) != 4:
                     raise ValueError(
-                        f"mock={part!r}: expected version,seqno,ntrial")
+                        f"mock={part!r}: expected "
+                        "[rank,]version,seqno,ntrial")
                 self.mock_spec.append(tuple(nums))
         elif name == "keepalive":
             self.keepalive = int(val)
@@ -167,8 +170,10 @@ class BoostLearnTask:
             # failure propagates as a nonzero exit instead.
             from xgboost_tpu.parallel import mock
             trial = int(os.environ.get("XGBTPU_NUM_TRIAL", "0"))
+            mine = [spec[1:] for spec in self.mock_spec
+                    if spec[0] in (-1, self.rank)]
             while True:
-                mock.set_fault_injection(self.mock_spec, trial)
+                mock.set_fault_injection(mine, trial)
                 try:
                     return self.task_train()
                 except mock.WorkerFailure as e:

@@ -32,7 +32,7 @@ class MetaInfo:
     """Per-row (and per-group) metadata (reference src/learner/dmatrix.h:18-145)."""
 
     __slots__ = ("label", "weight", "group_ptr", "base_margin",
-                 "root_index", "fold_index", "_dev_cache")
+                 "root_index", "fold_index", "_dev_cache", "version")
 
     def __init__(self):
         self.label: Optional[np.ndarray] = None
@@ -45,6 +45,7 @@ class MetaInfo:
         # (re-uploading label/weight every round costs more host<->device
         # time than the gradient computation itself)
         self._dev_cache: dict = {}
+        self.version = 0  # bumped on set_field: snapshot invalidation
 
     def get_weight(self, n_rows: int) -> np.ndarray:
         if self.weight is None:
@@ -75,6 +76,7 @@ class MetaInfo:
 
     def set_field(self, name: str, value) -> None:
         self._dev_cache.clear()
+        self.version += 1
         if value is None:
             setattr(self, name if name != "group" else "group_ptr", None)
             return

@@ -32,6 +32,11 @@ def load_dmatrix_into(dmat, uri: str, silent: bool = True,
         # streaming channel): spool to a temp file for the shared parser
         import sys
         import tempfile
+        if os.environ.get("XGBTPU_COORD"):
+            raise ValueError(
+                "data=stdin cannot be used under the multi-worker "
+                "launcher: every worker would race on one inherited "
+                "stdin pipe; pass a file path instead")
         with tempfile.NamedTemporaryFile("wb", suffix=".libsvm",
                                          delete=False) as tf:
             tf.write(sys.stdin.buffer.read())

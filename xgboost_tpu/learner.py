@@ -46,6 +46,7 @@ class _CacheEntry:
         self.margin: Optional[jax.Array] = None
         self.applied = 0                 # trees folded into margin
         self.external = external         # paged matrix: margin lives on host
+        self.info_version = dmat.info.version  # source-snapshot tracking
 
 
 class Booster:
@@ -174,6 +175,13 @@ class Booster:
             # another model re-quantized this matrix meanwhile: re-bin and
             # rebuild our margins from scratch
             self._cache[key] = self._build_ext_entry(dmat)
+        if (key in self._cache
+                and self._cache[key].info is not dmat.info
+                and self._cache[key].info_version != dmat.info.version):
+            # sharded entries snapshot the MetaInfo; a set_label/set_weight
+            # after caching must rebuild the snapshot (stale device labels
+            # would silently feed the gradients otherwise)
+            del self._cache[key]
         if key not in self._cache:
             if self.num_feature and dmat.num_col > self.num_feature:
                 raise ValueError(

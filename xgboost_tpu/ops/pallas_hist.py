@@ -45,17 +45,21 @@ def _round_up(x: int, m: int) -> int:
 
 def _hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
                  n_bin: int, m_pad: int, f_tile: int, precision_mode: str):
-    """One (feature_tile, row_tile) grid step.
+    """One (node_tile, feature_tile, row_tile) grid step.
 
     binned_ref: (f_tile, R) int32 bin ids, feature-major
     pos_ref:    (R, 1) int32 node position (-1 = inactive)
     gh_ref:     (R, 2) f32 grad/hess
-    out_ref:    (f_tile * n_bin, 2 * m_pad) f32 accumulator
+    out_ref:    (f_tile * n_bin, 2 * m_pad) f32 accumulator for the
+                m_pad nodes of THIS node tile (grid dim 0) — deep levels
+                (n_node > m_pad) tile the node dim so the block never
+                outgrows VMEM.
     """
     r_tile = binned_ref.shape[1]
     m2 = 2 * m_pad
+    m_base = pl.program_id(0) * m_pad  # first global node of this tile
 
-    @pl.when(pl.program_id(1) == 0)
+    @pl.when(pl.program_id(2) == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
@@ -63,7 +67,7 @@ def _hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
     # gh_exp[r, l] = gh[r, l // m_pad] masked by (pos[r] == l % m_pad);
     # built with broadcast selects (no lane concat, no relayout).
     lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, m2), 1)
-    node_of_lane = jnp.where(lane < m_pad, lane, lane - m_pad)
+    node_of_lane = m_base + jnp.where(lane < m_pad, lane, lane - m_pad)
     g = gh_ref[:, 0:1]
     h = gh_ref[:, 1:2]
     ghsel = jnp.where(lane < m_pad, g, h)                    # (R, 2M)
@@ -82,7 +86,7 @@ def _hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
             onehot, gh_exp, (((1,), (0,)), ((), ())),
             precision=prec,
             preferred_element_type=jnp.float32)              # (B, 2M)
-        out_ref[f * n_bin:(f + 1) * n_bin, :] += acc
+        out_ref[0, f * n_bin:(f + 1) * n_bin, :] += acc
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -104,10 +108,14 @@ def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
     # read at trace time: changing it after the first same-shape call has
     # no effect (jit cache) — set it before the first training round
     r_tile = int(os.environ.get("XGBTPU_HIST_RTILE", "1024"))
+    # deep levels tile the node dim at 64 (lane dim 2*64 = one full MXU
+    # pass) so the accumulator block stays VMEM-bounded at any depth
+    m_pad = min(n_node, 64)
+    n_m_tiles = -(-n_node // m_pad)
     # feature tile sized so the output block (f_tile*B, 2M) f32 stays
-    # ~<=1MB of VMEM at any depth (2M lanes grow with the level)
+    # ~<=1MB of VMEM
     f_tile = max(1, min(F, (256 * 1024) // (max(n_bin, 1) *
-                                            max(2 * n_node, 128))))
+                                            max(2 * m_pad, 128))))
     # TPU tile rule: a block's sublane dim must be a multiple of 8 OR
     # equal the full array dim.  Tile in multiples of 8 when tiling at
     # all; otherwise take the whole (un-padded) feature dim.
@@ -115,7 +123,6 @@ def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
         f_tile = max(8, (f_tile // 8) * 8)
     n_pad = _round_up(max(N, 1), r_tile)
     f_pad = _round_up(F, f_tile)
-    m_pad = n_node  # lanes pad to 128 inside the MXU anyway
 
     binned_t = binned.astype(jnp.int32).T                    # (F, N)
     if n_pad != N or f_pad != F:
@@ -127,23 +134,25 @@ def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
                                f_tile=f_tile, precision_mode=precision)
     out = pl.pallas_call(
         kernel,
-        grid=(f_pad // f_tile, n_pad // r_tile),
+        grid=(n_m_tiles, f_pad // f_tile, n_pad // r_tile),
         in_specs=[
-            pl.BlockSpec((f_tile, r_tile), lambda fi, ri: (fi, ri)),
-            pl.BlockSpec((r_tile, 1), lambda fi, ri: (ri, 0)),
-            pl.BlockSpec((r_tile, 2), lambda fi, ri: (ri, 0)),
+            pl.BlockSpec((f_tile, r_tile), lambda mi, fi, ri: (fi, ri)),
+            pl.BlockSpec((r_tile, 1), lambda mi, fi, ri: (ri, 0)),
+            pl.BlockSpec((r_tile, 2), lambda mi, fi, ri: (ri, 0)),
         ],
-        out_specs=pl.BlockSpec((f_tile * n_bin, 2 * m_pad),
-                               lambda fi, ri: (fi, 0)),
-        out_shape=jax.ShapeDtypeStruct((f_pad * n_bin, 2 * m_pad),
+        out_specs=pl.BlockSpec((1, f_tile * n_bin, 2 * m_pad),
+                               lambda mi, fi, ri: (mi, fi, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_m_tiles, f_pad * n_bin, 2 * m_pad),
                                        jnp.float32),
         interpret=interpret,
     )(binned_t, pos.reshape(-1, 1).astype(jnp.int32),
       gh.astype(jnp.float32))
 
-    # (f_pad*B, 2M) -> (F, B, 2, M) -> (M, F, B, 2)
-    out = out.reshape(f_pad, n_bin, 2, m_pad)
-    return out.transpose(3, 0, 1, 2)[:, :F, :, :]
+    # (m_tiles, f_pad*B, 2M) -> (m_tiles, F, B, 2, M) -> (m_tiles*M, F, B, 2)
+    out = out.reshape(n_m_tiles, f_pad, n_bin, 2, m_pad)
+    out = out.transpose(0, 4, 1, 2, 3).reshape(
+        n_m_tiles * m_pad, f_pad, n_bin, 2)
+    return out[:n_node, :F, :, :]
 
 
 def _nst_kernel(pos_ref, gh_ref, out_ref, *, m_pad: int):

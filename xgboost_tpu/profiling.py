@@ -118,7 +118,8 @@ class _Phase:
             # backends (axon tunnel); one single-element host pull is a
             # true barrier on the in-order stream (last leaf suffices)
             leaves = [x for x in jax.tree.leaves(self._blocked)
-                      if hasattr(x, "ravel")]
+                      if hasattr(x, "ravel")
+                      and getattr(x, "is_fully_addressable", True)]
             if leaves:
                 jax.device_get(leaves[-1].ravel()[:1])
         cur = self.prof._current
