@@ -116,3 +116,40 @@ def test_two_process_full_booster_training(tmp_path):
     bst = xgb.Booster(model_file=str(tmp_path / "mp.rank0.model"))
     p = np.asarray(bst.predict(xgb.DMatrix(str(data))))
     assert float(np.mean((p > 0.5) != y)) < 0.05
+
+
+def test_two_process_rank_specific_death_gang_restart(tmp_path):
+    """mock=rank,version,seqno,ntrial under the launcher: only the named
+    rank dies, the launcher restarts the whole gang (single processes
+    cannot rejoin a live jax.distributed job), and training resumes from
+    the checkpoint to a saved model."""
+    data = tmp_path / "train.libsvm"
+    rng = np.random.RandomState(9)
+    X = rng.rand(400, 5)
+    y = (X[:, 0] > 0.5).astype(int)
+    with open(data, "w") as fh:
+        for i in range(400):
+            feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(5))
+            fh.write(f"{y[i]} {feats}\n")
+
+    model = tmp_path / "ft.model"
+    cmd = [sys.executable, "-m", "xgboost_tpu.launch", "-n", "2",
+           "--local-devices", "2", "--keepalive", "--",
+           sys.executable, "-m", "xgboost_tpu",
+           f"data={data}", "objective=binary:logistic", "max_depth=3",
+           "eta=1.0", "num_round=4", "silent=2", "mock=1,2,0,0",
+           f"checkpoint_dir={tmp_path / 'ck'}", f"model_out={model}"]
+    # two full gang attempts (each pays jit compiles) plus the
+    # coordination-service error propagation make this the slowest test
+    r = subprocess.run(cmd, cwd=REPO, env=_clean_env(),
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-3000:]
+    # the death fired on rank 1 only, and the gang restarted once
+    assert "die at version=2" in r.stderr
+    assert "restarting all 2 workers, trial 1" in r.stderr
+    assert model.exists()
+
+    import xgboost_tpu as xgb
+    bst = xgb.Booster(model_file=str(model))
+    p = np.asarray(bst.predict(xgb.DMatrix(str(data))))
+    assert float(np.mean((p > 0.5) != y)) < 0.05

@@ -42,10 +42,13 @@ def grow_tree_dp(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
         # leaf-value gather stays inside the shard: indices are shard-local
         return tree, row_leaf, tree.leaf_value[row_leaf]
 
+    # check_vma=False: the Pallas histogram kernel's out_shape carries no
+    # vma annotation, and the psum'd tree outputs are replicated anyway
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
         out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
     )
     return fn(key, binned, gh, cut_values, n_cuts, row_valid)
 
@@ -65,6 +68,7 @@ def refresh_tree_dp(mesh: Mesh, tree, binned, gh, split_cfg, max_depth,
         body, mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(),
+        check_vma=False,
     )
     if row_valid is None:
         row_valid = jnp.ones(binned.shape[0], jnp.bool_)

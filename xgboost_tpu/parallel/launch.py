@@ -72,6 +72,13 @@ def init_worker(local_device_count: Optional[int] = None) -> bool:
                 flags + f" --xla_force_host_platform_device_count="
                 f"{local_device_count}").strip()
     import jax
+    if local_device_count is not None:
+        # virtual-CPU testing mode: pin the platform so a co-resident
+        # accelerator plugin (which overrides the JAX_PLATFORMS env var
+        # at import time) cannot become default_backend() and steer
+        # backend-conditional code (e.g. the histogram kernel choice)
+        # at a CPU-device mesh
+        jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=n, process_id=rank)
     return True
