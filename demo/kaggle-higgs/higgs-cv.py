@@ -1,0 +1,15 @@
+"""Cross-validation on the higgs-like data (reference demo/kaggle-higgs/
+higgs-cv.py): 5-fold CV with auc + ams@0.15."""
+from higgs_data import synth_higgs
+
+import xgboost_tpu as xgb
+
+data, label, weight = synth_higgs(n=20000, seed=44)
+dtrain = xgb.DMatrix(data, label=label, missing=-999.0, weight=weight)
+
+param = {"objective": "binary:logitraw", "eta": 0.1, "max_depth": 6,
+         "eval_metric": "auc"}
+res = xgb.cv(param, dtrain, num_boost_round=10, nfold=5,
+             metrics=("auc", "ams@0.15"), seed=0, verbose_eval=False)
+for line in res:
+    print(line)

@@ -1,0 +1,32 @@
+"""Prediction + submission formatting (reference demo/kaggle-higgs/
+higgs-pred.py): load the saved model, rank events by raw margin, label
+the top 15% as signal, write a submission-style CSV."""
+import numpy as np
+
+from higgs_data import synth_higgs
+
+import xgboost_tpu as xgb
+
+# make top 15% as signal
+threshold_ratio = 0.15
+
+data, label, weight = synth_higgs(n=20000, seed=43)
+xgmat = xgb.DMatrix(data, missing=-999.0)
+bst = xgb.Booster(model_file="higgs.model")
+ypred = np.asarray(bst.predict(xgmat, output_margin=True))
+
+res = [(i, ypred[i]) for i in range(len(ypred))]
+rorder = {}
+for k, v in sorted(res, key=lambda x: -x[1]):
+    rorder[k] = len(rorder) + 1
+
+ntop = int(threshold_ratio * len(rorder))
+with open("higgs.submission.csv", "w") as fo:
+    fo.write("EventId,RankOrder,Class\n")
+    nhit = 0
+    for k, v in res:
+        cls = "s" if rorder[k] <= ntop else "b"
+        if cls == "s":
+            nhit += 1
+        fo.write("%s,%d,%s\n" % (k, len(rorder) + 1 - rorder[k], cls))
+print("finished writing into prediction file (%d signal)" % nhit)

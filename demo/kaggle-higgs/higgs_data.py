@@ -1,0 +1,17 @@
+"""Shared higgs-like synthetic stand-in for the unbundled competition
+CSV: 30 features, -999.0 missing sentinel, per-event weights,
+imbalanced signal/background — same shape as the reference demo's data
+pipeline expects."""
+import numpy as np
+
+
+def synth_higgs(n=50000, f=30, seed=42):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    margin = X[:, 0] + 0.8 * X[:, 1] * X[:, 2] - 0.5 * X[:, 3] ** 2 + 1.0
+    y = (margin + rng.randn(n) > 0.8).astype(np.float32)
+    # detector-style missingness: -999.0 sentinel on a feature block
+    mask = rng.rand(n, f) < 0.1
+    X[mask] = -999.0
+    w = rng.gamma(2.0, 1.0, size=n).astype(np.float32)
+    return X, y, w

@@ -1,0 +1,11 @@
+#!/bin/sh
+# Higgs demo driver (reference demo/kaggle-higgs/run.sh: train then pred)
+set -e
+cd "$(dirname "$0")"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export PYTHONPATH="$(cd ../.. && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+python higgs-numpy.py
+python higgs-pred.py
+head -3 higgs.submission.csv
+rm -f higgs.model higgs.submission.csv
+echo "higgs demo ok"

@@ -1,0 +1,24 @@
+"""Generate a YearPredictionMSD-like libsvm train/test pair (the UCI
+download of the reference's runexp.sh is unavailable offline): 90 audio
+timbre features, year labels 1922-2011 correlated with the features."""
+import numpy as np
+
+rng = np.random.RandomState(11)
+n, f = 8000, 90
+X = rng.randn(n, f).astype(np.float32)
+year = np.clip(
+    1998 + 6 * X[:, 0] - 4 * X[:, 1] + 2 * X[:, 2] * X[:, 3]
+    + 3 * rng.randn(n), 1922, 2011).round()
+
+
+def write(path, Xs, ys):
+    with open(path, "w") as fo:
+        for row, label in zip(Xs, ys):
+            feats = " ".join("%d:%.4f" % (j, v) for j, v in enumerate(row))
+            fo.write("%d %s\n" % (label, feats))
+
+
+cut = int(n * 0.9)  # the reference splits head/tail of one file
+write("yearpredMSD.libsvm.train", X[:cut], year[:cut])
+write("yearpredMSD.libsvm.test", X[cut:], year[cut:])
+print("wrote yearpredMSD.libsvm.{train,test}")

@@ -1,0 +1,14 @@
+#!/bin/sh
+# YearPredictionMSD experiment (reference demo/yearpredMSD/runexp.sh):
+# make libsvm data, train via the CLI config.
+set -e
+cd "$(dirname "$0")"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export PYTHONPATH="$(cd ../.. && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+if [ ! -f yearpredMSD.libsvm.train ]; then
+    echo "making synthetic yearpredMSD data (UCI download unavailable offline)"
+    python make_data.py
+fi
+python -m xgboost_tpu yearpredMSD.conf model_out=NONE
+rm -f yearpredMSD.libsvm.train yearpredMSD.libsvm.test
+echo "yearpredMSD demo ok"

@@ -204,3 +204,40 @@ def test_ext_distributed_row_split_8way_quality(tmp_path):
               evals_result=r2, verbose_eval=False)
     e1, e2 = float(r1["train-error"][-1]), float(r2["train-error"][-1])
     assert abs(e1 - e2) <= 0.01, (e1, e2)
+
+
+def test_half_ram_variant_matches_paged(tmp_path):
+    """HalfRAM ('!' prefix, reference DMatrixHalfRAM io.cpp:70-73): raw
+    rows paged on disk, binned matrix in RAM — must train identically to
+    the memmap-backed paged matrix."""
+    X, y = make_data(n=1500, f=6, seed=3)
+    svm = tmp_path / "hr.svm"
+    with open(svm, "w") as f:
+        for row, lab in zip(X, y):
+            feats = " ".join(f"{j}:{v:.6f}" for j, v in enumerate(row))
+            f.write(f"{lab:g} {feats}\n")
+
+    d_page = ExtMemDMatrix(f"{svm}#{tmp_path / 'p'}")
+    d_half = xgb.DMatrix(f"!{svm}#{tmp_path / 'h'}")  # DMatrix URI route
+    assert isinstance(d_half, ExtMemDMatrix) and d_half.half_ram
+    b1 = xgb.train(PARAMS, d_page, 4, verbose_eval=False)
+    b2 = xgb.train(PARAMS, d_half, 4, verbose_eval=False)
+    np.testing.assert_allclose(np.asarray(b1.predict(d_page)),
+                               np.asarray(b2.predict(d_half)),
+                               rtol=1e-6, atol=1e-7)
+    # HalfRAM keeps the binned matrix off disk
+    import os
+    assert not os.path.exists(str(tmp_path / "h") + ".binned")
+
+
+def test_dmatrix_ext_uri_route(tmp_path):
+    """DMatrix('ext:path#cache') constructs the paged matrix."""
+    X, y = make_data(n=800, f=5, seed=4)
+    svm = tmp_path / "u.svm"
+    with open(svm, "w") as f:
+        for row, lab in zip(X, y):
+            feats = " ".join(f"{j}:{v:.6f}" for j, v in enumerate(row))
+            f.write(f"{lab:g} {feats}\n")
+    d = xgb.DMatrix(f"ext:{svm}#{tmp_path / 'u'}")
+    assert isinstance(d, ExtMemDMatrix) and not d.half_ram
+    assert d.num_row == 800 and d.num_col == 5

@@ -196,23 +196,12 @@ class BoostLearnTask:
 
     # ------------------------------------------------------------- helpers
     def _params_dict(self) -> Dict[str, str]:
-        d: Dict[str, str] = {}
-        metrics: List[str] = []
-        for k, v in self.learner_params:
-            if k == "eval_metric":
-                metrics.append(v)
-            else:
-                d[k] = v
-        if metrics:
-            d["eval_metric"] = metrics
-        return d
+        from xgboost_tpu.config import params_to_dict
+        return params_to_dict(self.learner_params)
 
     def _load_data(self, path: str):
-        if path.startswith("ext:"):
-            # external-memory matrix (reference's paged DMatrix via the
-            # #cachefile convention, io.cpp:20-29)
-            from xgboost_tpu.external import ExtMemDMatrix
-            return ExtMemDMatrix(path[4:], silent=self.silent != 0)
+        # "ext:" (paged, io.cpp:20-29) and "!" (HalfRAM, io.cpp:70-73)
+        # URIs are routed by DMatrix.__new__ itself
         from xgboost_tpu.data import DMatrix
         return DMatrix(path, silent=self.silent != 0)
 

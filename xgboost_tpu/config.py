@@ -28,6 +28,24 @@ def canonical_name(name: str) -> str:
     return _ALIASES.get(name, name)
 
 
+def params_to_dict(params) -> Dict[str, Any]:
+    """Normalize a params dict OR (name, value) pair sequence to a dict,
+    collecting repeated ``eval_metric`` entries into a list (the
+    reference wrapper's pair-list idiom for watching several metrics)."""
+    if isinstance(params, dict):
+        return dict(params)
+    out: Dict[str, Any] = {}
+    ems: List[str] = []
+    for k, v in (params or ()):
+        if k == "eval_metric":
+            ems.extend(v if isinstance(v, (list, tuple)) else [v])
+        else:
+            out[k] = v
+    if ems:
+        out["eval_metric"] = ems
+    return out
+
+
 @dataclasses.dataclass
 class TrainParam:
     """All training hyperparameters.
@@ -123,8 +141,12 @@ class TrainParam:
 
     @classmethod
     def from_dict(cls, params: Optional[Dict[str, Any]]) -> "TrainParam":
+        """Build from a dict OR a sequence of (name, value) pairs — the
+        reference wrapper accepts both (``list(param.items()) +
+        [('eval_metric', ...)]`` is its idiom for repeated metrics,
+        wrapper/xgboost.py train callers)."""
         p = cls()
-        for k, v in (params or {}).items():
+        for k, v in params_to_dict(params).items():
             p.set_param(k, v)
         return p
 

@@ -115,6 +115,34 @@ class DMatrix:
     scipy CSR/CSC, or a (indptr, indices, values, num_col) CSR tuple.
     """
 
+    def __new__(cls, data: Any = None, *args, **kwargs):
+        # "ext:path" / "!path" URIs construct the paged matrix (reference
+        # io.cpp routes paged magics and the '!' HalfRAM prefix the same
+        # way, io.cpp:36-81); ExtMemDMatrix is not a subclass, so
+        # __init__ below is skipped for it.
+        if cls is DMatrix and isinstance(data, str) and (
+                data.startswith("ext:") or data.startswith("!")):
+            from xgboost_tpu.external import ExtMemDMatrix
+            path = data[4:] if data.startswith("ext:") else data
+            names = ("label", "weight", "missing", "base_margin", "group",
+                     "num_col", "silent", "feature_names")
+            for name, val in zip(names, args):
+                kwargs.setdefault(name, val)
+            unsupported = [k for k in ("base_margin", "group", "num_col",
+                                       "feature_names")
+                           if kwargs.get(k) is not None]
+            if unsupported:
+                raise ValueError(
+                    f"DMatrix({data!r}): {unsupported} not supported on "
+                    "external-memory matrices; construct ExtMemDMatrix and "
+                    "use set_base_margin/set_group instead")
+            return ExtMemDMatrix(
+                path, label=kwargs.get("label"),
+                weight=kwargs.get("weight"),
+                missing=kwargs.get("missing", np.nan),
+                silent=kwargs.get("silent", True))
+        return super().__new__(cls)
+
     def __init__(self, data: Any, label=None, weight=None, missing: float = np.nan,
                  base_margin=None, group=None, num_col: Optional[int] = None,
                  silent: bool = True, feature_names: Optional[Sequence[str]] = None):

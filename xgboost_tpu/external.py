@@ -52,6 +52,12 @@ class ExtMemDMatrix:
     ``(X_dense, y)`` chunks.  Raw CSR pages are spilled to
     ``<cache>.pages``; after binning, a ``<cache>.binned`` memmap holds
     the quantized matrix.
+
+    A ``!`` path prefix (or ``half_ram=True``) selects the HalfRAM
+    variant (reference ``DMatrixHalfRAM``, magic 0xffffab03, selected by
+    ``!`` at ``io.cpp:70-73``): raw CSR rows stay paged on disk but the
+    compact working set — here the quantized bin matrix — is held in
+    host RAM instead of a memmap, trading RAM for batch-access speed.
     """
 
     is_external = True
@@ -59,7 +65,7 @@ class ExtMemDMatrix:
     def __init__(self, data, label=None, weight=None,
                  cache: Optional[str] = None,
                  page_rows: int = DEFAULT_PAGE_ROWS, missing: float = np.nan,
-                 silent: bool = True):
+                 silent: bool = True, half_ram: bool = False):
         self.info = MetaInfo()
         self.page_rows = page_rows
         self._binned_path: Optional[str] = None
@@ -69,7 +75,11 @@ class ExtMemDMatrix:
         self.feature_names = None
         self._col_cache = None
 
+        self.half_ram = half_ram
         if isinstance(data, str):
+            if data.startswith("!"):
+                self.half_ram = True
+                data = data[1:]
             path, _, cachesuffix = data.partition("#")
             if cache is None:
                 cache = cachesuffix or path + ".extcache"
@@ -271,9 +281,12 @@ class ExtMemDMatrix:
         traversal never gathers out of bounds."""
         width = max(self.num_col, cuts.num_feature)
         self._binned_dtype = np.uint8 if cuts.max_bin <= 256 else np.uint16
-        self._binned_path = self.cache_prefix + ".binned"
-        mm = np.memmap(self._binned_path, dtype=self._binned_dtype,
-                       mode="w+", shape=(self.num_row, width))
+        if self.half_ram:
+            mm = np.zeros((self.num_row, width), dtype=self._binned_dtype)
+        else:
+            self._binned_path = self.cache_prefix + ".binned"
+            mm = np.memmap(self._binned_path, dtype=self._binned_dtype,
+                           mode="w+", shape=(self.num_row, width))
         row0 = 0
         for indptr, indices, values in self.iter_raw_pages():
             n = len(indptr) - 1
@@ -289,10 +302,13 @@ class ExtMemDMatrix:
                 page[rows[m], f] = b.astype(self._binned_dtype)
             mm[row0:row0 + n] = page
             row0 += n
-        mm.flush()
-        self._binned_mm = np.memmap(self._binned_path,
-                                    dtype=self._binned_dtype, mode="r",
-                                    shape=(self.num_row, width))
+        if self.half_ram:
+            self._binned_mm = mm
+        else:
+            mm.flush()
+            self._binned_mm = np.memmap(self._binned_path,
+                                        dtype=self._binned_dtype, mode="r",
+                                        shape=(self.num_row, width))
         self._binned_cuts = cuts  # identity-tracked: see Booster._entry
 
     def binned_batches(self, batch_rows: Optional[int] = None):

@@ -70,8 +70,9 @@ class Booster:
 
     # ----------------------------------------------------------- parameters
     def set_param(self, name, value=None):
-        if isinstance(name, dict):
-            for k, v in name.items():
+        if isinstance(name, (dict, list, tuple)):
+            from xgboost_tpu.config import params_to_dict
+            for k, v in params_to_dict(name).items():
                 self.param.set_param(k, v)
         else:
             self.param.set_param(name, value)
@@ -854,7 +855,8 @@ def cv(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
        show_stdv: bool = True, seed: int = 0,
        verbose_eval: bool = True) -> List[str]:
     """k-fold cross validation (reference wrapper/xgboost.py:697-740)."""
-    params = dict(params or {})
+    from xgboost_tpu.config import params_to_dict
+    params = params_to_dict(params)
     if metrics:
         params["eval_metric"] = list(metrics)
     packs = mknfold(dtrain, nfold, params, seed, fpreproc=fpreproc)
