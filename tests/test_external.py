@@ -20,6 +20,13 @@ def chunked(X, y, size):
         yield X[s:s + size], y[s:s + size]
 
 
+def write_svm(path, X, y):
+    with open(path, "w") as f:
+        for row, lab in zip(X, y):
+            feats = " ".join(f"{j}:{v:.6f}" for j, v in enumerate(row))
+            f.write(f"{lab:g} {feats}\n")
+
+
 PARAMS = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.5}
 
 
@@ -71,10 +78,7 @@ def test_ext_eval_on_separate_matrix(tmp_path):
 def test_ext_from_libsvm_and_cli(tmp_path):
     X, y = make_data(n=1200, f=6, seed=2)
     svm = tmp_path / "train.svm"
-    with open(svm, "w") as f:
-        for row, lab in zip(X, y):
-            feats = " ".join(f"{j}:{v:.6f}" for j, v in enumerate(row))
-            f.write(f"{lab:g} {feats}\n")
+    write_svm(svm, X, y)
 
     d = ExtMemDMatrix(f"{svm}#{tmp_path / 'cc'}")
     assert d.num_row == 1200 and d.num_col == 6
@@ -212,10 +216,7 @@ def test_half_ram_variant_matches_paged(tmp_path):
     the memmap-backed paged matrix."""
     X, y = make_data(n=1500, f=6, seed=3)
     svm = tmp_path / "hr.svm"
-    with open(svm, "w") as f:
-        for row, lab in zip(X, y):
-            feats = " ".join(f"{j}:{v:.6f}" for j, v in enumerate(row))
-            f.write(f"{lab:g} {feats}\n")
+    write_svm(svm, X, y)
 
     d_page = ExtMemDMatrix(f"{svm}#{tmp_path / 'p'}")
     d_half = xgb.DMatrix(f"!{svm}#{tmp_path / 'h'}")  # DMatrix URI route
@@ -234,10 +235,7 @@ def test_dmatrix_ext_uri_route(tmp_path):
     """DMatrix('ext:path#cache') constructs the paged matrix."""
     X, y = make_data(n=800, f=5, seed=4)
     svm = tmp_path / "u.svm"
-    with open(svm, "w") as f:
-        for row, lab in zip(X, y):
-            feats = " ".join(f"{j}:{v:.6f}" for j, v in enumerate(row))
-            f.write(f"{lab:g} {feats}\n")
+    write_svm(svm, X, y)
     d = xgb.DMatrix(f"ext:{svm}#{tmp_path / 'u'}")
     assert isinstance(d, ExtMemDMatrix) and not d.half_ram
     assert d.num_row == 800 and d.num_col == 5

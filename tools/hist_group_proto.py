@@ -1,0 +1,131 @@
+"""Prototype: feature-grouped one-hot matmul for the histogram kernel.
+
+Instead of one (B, R) @ (R, 2M) matmul per feature (which fills only
+B=67 of the MXU's 128 output sublanes), concatenate ``fg`` features'
+one-hots — each padded to Bp = roundup(B, 8) sublanes — into one
+(fg*Bp, R) operand and run one matmul per group.  MXU row-blocks per
+step drop from fg*ceil(B/128) to ceil(fg*Bp/128).
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, ".")
+from tools.hist_microbench import timeit  # noqa: E402
+from xgboost_tpu.ops.pallas_hist import _round_up  # noqa: E402
+
+
+def _grouped_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
+                    n_bin, b_pad, m_pad, f_tile, fg, hot_dtype):
+    r_tile = binned_ref.shape[1]
+    m2 = 2 * m_pad
+    m_base = pl.program_id(0) * m_pad
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    pos = pos_ref[:, 0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, m2), 1)
+    node_of_lane = m_base + jnp.where(lane < m_pad, lane, lane - m_pad)
+    ghsel = jnp.where(lane < m_pad, gh_ref[:, 0:1], gh_ref[:, 1:2])
+    gh_exp = jnp.where(pos[:, None] == node_of_lane, ghsel, 0.0)
+    gh_exp = gh_exp.astype(hot_dtype)
+
+    bins = binned_ref[:]
+    bin_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, r_tile), 0)
+    n_group = f_tile // fg
+    for g in range(n_group):
+        hots = []
+        for j in range(fg):
+            f = g * fg + j
+            # bin_ids rows >= n_bin never match (bins < n_bin)
+            hots.append((bins[f:f + 1, :] == bin_ids).astype(hot_dtype))
+        onehot = jnp.concatenate(hots, axis=0)          # (fg*b_pad, R)
+        acc = jax.lax.dot_general(
+            onehot, gh_exp, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)          # (fg*b_pad, 2M)
+        out_ref[0, g * fg * b_pad:(g + 1) * fg * b_pad, :] += acc
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_node", "n_bin", "fg", "r_tile", "hot_dtype"))
+def grouped(binned, gh, pos, n_node, n_bin, fg=4, r_tile=2048,
+            hot_dtype=jnp.bfloat16):
+    N, F = binned.shape
+    m_pad = min(n_node, 64)
+    n_m_tiles = -(-n_node // m_pad)
+    b_pad = _round_up(n_bin, 8)
+    f_tile = _round_up(F, fg)
+    n_pad = _round_up(max(N, 1), r_tile)
+    f_pad = f_tile
+
+    binned_t = binned.astype(jnp.int32).T
+    if n_pad != N or f_pad != F:
+        binned_t = jnp.pad(binned_t, ((0, f_pad - F), (0, n_pad - N)))
+        gh = jnp.pad(gh, ((0, n_pad - N), (0, 0)))
+        pos = jnp.pad(pos, (0, n_pad - N), constant_values=-1)
+
+    kernel = functools.partial(_grouped_kernel, n_bin=n_bin, b_pad=b_pad,
+                               m_pad=m_pad, f_tile=f_tile, fg=fg,
+                               hot_dtype=hot_dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_m_tiles, 1, n_pad // r_tile),
+        in_specs=[
+            pl.BlockSpec((f_tile, r_tile), lambda mi, fi, ri: (fi, ri)),
+            pl.BlockSpec((r_tile, 1), lambda mi, fi, ri: (ri, 0)),
+            pl.BlockSpec((r_tile, 2), lambda mi, fi, ri: (ri, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, f_pad * b_pad, 2 * m_pad),
+                               lambda mi, fi, ri: (mi, fi, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_m_tiles, f_pad * b_pad, 2 * m_pad),
+                                       jnp.float32),
+    )(binned_t, pos.reshape(-1, 1).astype(jnp.int32),
+      gh.astype(jnp.float32))
+
+    # (m_tiles, f_pad*Bp, 2M) -> (m_tiles*M, F, B, 2)
+    out = out.reshape(n_m_tiles, f_pad, b_pad, 2, m_pad)
+    out = out.transpose(0, 4, 1, 2, 3).reshape(
+        n_m_tiles * m_pad, f_pad, b_pad, 2)
+    return out[:n_node, :F, :n_bin, :]
+
+
+def main():
+    from xgboost_tpu.ops.pallas_hist import build_level_histogram_pallas
+    n, f, b, n_node = 1_000_000, 28, 67, 64
+    rng = np.random.RandomState(0)
+    binned = jnp.asarray(rng.randint(0, b, size=(n, f)), jnp.int32)
+    gh = jnp.asarray(rng.randn(n, 2), jnp.float32)
+    pos = jnp.asarray(rng.randint(0, n_node, size=n), jnp.int32)
+
+    ref = np.asarray(build_level_histogram_pallas(
+        binned, gh, pos, n_node, b, precision="fp32"))
+    got = np.asarray(grouped(binned[:4096], gh[:4096], pos[:4096],
+                             n_node, b, fg=4))
+    ref4 = np.asarray(build_level_histogram_pallas(
+        binned[:4096], gh[:4096], pos[:4096], n_node, b, precision="fp32"))
+    err = np.abs(got - ref4).max()
+    print("small parity max err (bf16 vs f32):", err)
+
+    ms = timeit(build_level_histogram_pallas, binned, gh, pos, n_node, b,
+                precision="bf16")
+    print(f"production bf16   : {ms:7.2f} ms")
+    for fg in (2, 4, 7, 14):
+        for r in (1024, 2048, 4096):
+            try:
+                ms = timeit(grouped, binned, gh, pos, n_node, b,
+                            fg=fg, r_tile=r)
+                print(f"grouped fg={fg:2d} r={r:5d}: {ms:7.2f} ms")
+            except Exception as e:
+                print(f"grouped fg={fg:2d} r={r:5d}: FAILED {str(e)[:70]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -760,7 +760,12 @@ def train(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
         prof = bst.profiler
         with prof.phase("eval") if prof else nullcontext():
             msg = bst.eval_set(evals, i, feval)  # folds into ended round
-        if verbose_eval:
+        # bool => on/off; int N > 1 => print every N rounds (and the
+        # last), the newer reference wrappers' print-period idiom
+        if verbose_eval and (
+                verbose_eval is True or int(verbose_eval) <= 1
+                or i % int(verbose_eval) == 0
+                or i == num_boost_round - 1):
             print(msg)
         scores = _parse_eval(msg)
         if evals_result is not None:
@@ -816,6 +821,7 @@ class CVPack:
 def mknfold(dall: DMatrix, nfold: int, params: dict, seed: int,
             evals=(), fpreproc=None) -> List[CVPack]:
     """Random nfold partition (reference wrapper/xgboost.py:652-674)."""
+    from xgboost_tpu.config import params_to_dict
     rng = np.random.RandomState(seed)
     idx = rng.permutation(dall.num_row)
     folds = np.array_split(idx, nfold)
@@ -825,7 +831,7 @@ def mknfold(dall: DMatrix, nfold: int, params: dict, seed: int,
         train_idx = np.concatenate([folds[j] for j in range(nfold) if j != k])
         dtrain = dall.slice(np.sort(train_idx))
         dtest = dall.slice(np.sort(test_idx))
-        p = dict(params or {})
+        p = params_to_dict(params)
         if fpreproc is not None:
             dtrain, dtest, p = fpreproc(dtrain, dtest, p)
         packs.append(CVPack(dtrain, dtest, p))
