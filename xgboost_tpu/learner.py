@@ -379,6 +379,47 @@ class Booster:
             if prof and entry.margin is not None:
                 p.block(entry.margin)
 
+    def update_many(self, dtrain: DMatrix, first_iteration: int,
+                    n_rounds: int, fobj=None) -> None:
+        """Run ``n_rounds`` boosting rounds, fused into ONE device launch
+        when nothing needs the host between rounds (no eval, no pruning,
+        no refresh, no fault injection, no custom/rank objective, no
+        column split, no profiler, in-memory gbtree).  Falls back to
+        per-round :meth:`update` otherwise.  The fused path bit-matches
+        the sequential path (same per-round keys and kernels) — the
+        reference's round loop is host-side by construction
+        (xgboost_main.cpp:183-217); here it compiles into the program.
+        """
+        from xgboost_tpu.models.updaters import parse_updaters
+        from xgboost_tpu.parallel import mock
+
+        self._lazy_init(dtrain)
+        entry = self._entry(dtrain)
+        ups = parse_updaters(self.param.updater)
+        fused_ok = (
+            fobj is None
+            and n_rounds > 1
+            and self.param.booster == "gbtree"
+            and not entry.external
+            and self._col_mesh is None
+            and not mock.active()
+            and self.profiler is None
+            and not (self.param.gamma > 0.0 and "prune" in ups)
+            and "refresh" not in ups
+            and any(u.startswith("grow") for u in ups)
+            and self.obj.fused_grad() is not None)
+        if not fused_ok:
+            for i in range(first_iteration, first_iteration + n_rounds):
+                self.update(dtrain, i, fobj)
+            return
+        self.obj.validate_labels(entry.info)  # host check, once per info
+        self._sync_margin(entry)
+        entry.margin = self.gbtree.do_boost_fused(
+            entry.binned, entry.margin, entry.info, self.obj.fused_grad(),
+            first_iteration, n_rounds, row_valid=entry.row_valid,
+            mesh=self._mesh)
+        entry.applied = self.gbtree.num_trees
+
     def boost(self, dtrain: DMatrix, grad, hess):
         """Boost from user-supplied gradients (reference
         XGBoosterBoostOneIter, wrapper/xgboost_wrapper.cpp:310-317)."""
@@ -752,7 +793,16 @@ def train(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
     best_iter = 0
     best_msg = ""
 
-    for i in range(num_boost_round):
+    if not evals and early_stopping_rounds is None:
+        # nothing runs on the host between rounds: fuse the whole round
+        # loop into one device launch where eligible (update_many falls
+        # back to per-round updates otherwise)
+        bst.update_many(dtrain, 0, num_boost_round, fobj=obj)
+        rounds = ()
+    else:
+        rounds = range(num_boost_round)
+
+    for i in rounds:
         bst.update(dtrain, i, fobj=obj)
         if not evals:
             continue

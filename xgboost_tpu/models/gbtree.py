@@ -12,6 +12,7 @@ fast path as the reference's ``GetLeafPosition`` shortcut
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, List, Optional, Tuple
 
 import jax
@@ -35,6 +36,49 @@ def make_grow_config(p: TrainParam, n_bin: int) -> GrowConfig:
                       subsample=p.subsample,
                       colsample_bytree=p.colsample_bytree,
                       colsample_bylevel=p.colsample_bylevel)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_rounds", "K", "npar", "cfg", "split_finder", "grad_fn", "mesh"))
+def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
+                 cut_values, n_cuts, row_valid, *, n_rounds: int, K: int,
+                 npar: int, cfg: GrowConfig, split_finder, grad_fn, mesh):
+    """``lax.scan`` over whole boosting rounds (one device launch for
+    n_rounds x K x npar trees).  Module-level so the jit cache is shared
+    across Booster instances: all static arguments (cfg, grad_fn,
+    split_finder) carry stable identities.
+
+    Returns (final margin (N, K), stacked trees (n_rounds, K*npar, ...)).
+    """
+    def body(margin, i):
+        key = jax.random.fold_in(base_key, i)
+        gh = grad_fn(margin, label, weight, i)           # (N, K, 2)
+        trees = []
+        delta = jnp.zeros_like(margin)
+        for k in range(K):
+            for t in range(npar):
+                tkey = jax.random.fold_in(key, k * npar + t)
+                if mesh is not None:
+                    from xgboost_tpu.parallel.dp import grow_tree_dp
+                    rv = (row_valid if row_valid is not None
+                          else jnp.ones(binned.shape[0], jnp.bool_))
+                    tree, row_leaf, d = grow_tree_dp(
+                        mesh, tkey, binned, gh[:, k, :], cut_values,
+                        n_cuts, cfg, rv, split_finder=split_finder)
+                else:
+                    tree, row_leaf = grow_tree(
+                        tkey, binned, gh[:, k, :], cut_values, n_cuts,
+                        cfg, row_valid, split_finder=split_finder)
+                    d = tree.leaf_value[row_leaf]
+                if row_valid is not None:
+                    d = d * row_valid.astype(d.dtype)
+                delta = delta.at[:, k].add(d)
+                trees.append(tree)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+        return margin + delta, stacked
+
+    iters = first_iteration + jnp.arange(n_rounds)
+    return jax.lax.scan(body, margin, iters)
 
 
 class GBTree:
@@ -246,6 +290,73 @@ class GBTree:
             deltas = deltas.at[:, i // npar].add(d)
         self._stack_cache = None
         return new_trees, deltas
+
+    # ------------------------------------------------------------ fused boost
+    def do_boost_fused(self, binned, margin, info, grad_fn,
+                       first_iteration: int, n_rounds: int,
+                       row_valid=None, mesh=None):
+        """Scan ``n_rounds`` whole boosting rounds in ONE device launch.
+
+        Per-round host dispatch (gradient launch + growth launch + margin
+        update) costs ~2-3 ms each through a tunnel-attached TPU
+        (PROFILE.md); folding the round loop into ``lax.scan`` removes it
+        entirely and lets XLA pipeline rounds back-to-back.  The round
+        body replays the sequential path exactly — same per-round
+        ``fold_in`` keys, same kernels — so the resulting model
+        bit-matches ``do_boost`` called ``n_rounds`` times (tested).
+
+        The reference has no analog (its round loop is inherently
+        host-side, ``xgboost_main.cpp:183-217``); this is the TPU-native
+        shape of "the round loop is itself a compiled program".
+
+        Restrictions (callers fall back to per-round ``do_boost``):
+        no pruning (``gamma > 0`` pruning is a host-side pass), no
+        refresh, no column split, no fault injection, and a jittable
+        gradient function (standard reg/softmax objectives).
+
+        Args:
+          margin: (N, K) current margins (device).
+          info: MetaInfo supplying device-cached label/weight.
+          grad_fn: pure ``(margin, label, weight, iteration) -> (N, K, 2)``
+            gradient with stable identity (Objective.fused_grad).
+          row_valid: optional (N,) bool mask of real rows.
+          mesh: optional data-parallel mesh (rows sharded over 'data').
+
+        Returns the final (N, K) margin; grown trees are appended.
+        """
+        K = max(1, self.param.num_output_group)
+        npar = max(1, self.param.num_parallel_tree)
+        label = info.label_dev()
+        weight = info.weight_dev(margin.shape[0])
+        margin_f, stacks = _scan_rounds(
+            binned, margin, label, weight,
+            jax.random.PRNGKey(self.param.seed),
+            jnp.int32(first_iteration), self.cut_values_dev,
+            self.n_cuts_dev, row_valid,
+            n_rounds=n_rounds, K=K, npar=npar, cfg=self.cfg,
+            split_finder=self._split_finder(), grad_fn=grad_fn, mesh=mesh)
+        # flatten (n_rounds, K*npar, ...) -> (T_new, ...) and install the
+        # full-ensemble stack cache directly: prediction then reuses the
+        # scan's own output instead of re-stacking T per-tree slices
+        flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]),
+                            stacks)
+        group_new = [j // npar for _ in range(n_rounds)
+                     for j in range(K * npar)]
+        if self.trees:
+            old_stack, old_group = self._stack(0)
+            full = jax.tree.map(lambda a, b: jnp.concatenate([a, b]),
+                                old_stack, flat)
+            full_group = jnp.concatenate(
+                [old_group, jnp.asarray(group_new, jnp.int32)])
+        else:
+            full = flat
+            full_group = jnp.asarray(group_new, jnp.int32)
+        T_new = n_rounds * K * npar
+        self.trees.extend(jax.tree.map(lambda x: x[j], flat)
+                          for j in range(T_new))
+        self.tree_group.extend(group_new)
+        self._stack_cache = (len(self.trees), full, full_group)
+        return margin_f
 
     # ----------------------------------------------------------- paged boost
     def do_boost_paged(self, dmat, gh: np.ndarray, key: jax.Array,

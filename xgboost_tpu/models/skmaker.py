@@ -26,6 +26,8 @@ stats, so ``refresh`` is optional rather than required.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -78,11 +80,17 @@ def _rank_at(values: jax.Array, rank_next: jax.Array, q: jax.Array):
     return jnp.where(idx < 0, 0.0, rank_next[safe])
 
 
+@functools.lru_cache(maxsize=None)
 def skmaker_split_finder(K: int):
     """Build a ``grow_tree`` split_finder implementing skmaker.
 
     K: summary size per (node, feature, kind) — the reference's
     max_sketch_size = sketch_ratio / sketch_eps.
+
+    Memoized so equal K yields a stable function identity: the finder is
+    a jit static argument of the growers (and of the fused round scan),
+    so identity stability is what makes their compile caches shared
+    across Booster instances.
     """
 
     def finder(hist, nst, n_cuts, cut_values, fmask, split_cfg):

@@ -54,6 +54,30 @@ class Objective:
     def prob_to_margin(self, base_score: float) -> float:
         return base_score
 
+    def fused_grad(self):
+        """A pure ``(margin, label, weight, iteration) -> (N, K, 2)``
+        gradient for the fused multi-round scan (GBTree.do_boost_fused),
+        or None when the objective needs host-side work per round (rank
+        pair sampling, custom objectives).  Must return a STABLE function
+        identity per hyperparameter setting so the scan's jit cache hits
+        across boosters."""
+        return None
+
+    def validate_labels(self, info) -> None:
+        """Host-side label validation (once per info); shared by
+        get_gradient and the fused path which bypasses it."""
+
+
+@functools.lru_cache(maxsize=None)
+def _regloss_fused(loss: str, spw: float):
+    def f(margin, label, weight, iteration):
+        return _regloss_grad(margin, label, weight, loss, spw)
+    return f
+
+
+def _softmax_fused(margin, label, weight, iteration):
+    return _softmax_grad(margin, label, weight)
+
 
 @functools.partial(jax.jit, static_argnames=("loss", "spw"))
 def _regloss_grad(margin, label, weight, loss: str, spw: float):
@@ -86,7 +110,7 @@ class RegLossObj(Objective):
         if name == "scale_pos_weight":
             self.scale_pos_weight = float(value)
 
-    def get_gradient(self, margin, info, iteration, n_rows):
+    def validate_labels(self, info) -> None:
         if self.loss != "linear":
             def _check():
                 lab = np.asarray(info.label)
@@ -94,6 +118,9 @@ class RegLossObj(Objective):
                     raise ValueError(
                         "label must be in [0,1] for logistic regression")
             info.check_once("logistic_label_ok", _check)
+
+    def get_gradient(self, margin, info, iteration, n_rows):
+        self.validate_labels(info)
         return _regloss_grad(margin, info.label_dev(),
                              info.weight_dev(n_rows), self.loss,
                              float(self.scale_pos_weight))
@@ -114,6 +141,9 @@ class RegLossObj(Objective):
                 "base_score must be in (0,1) for logistic loss"
             return -np.log(1.0 / base_score - 1.0)
         return base_score
+
+    def fused_grad(self):
+        return _regloss_fused(self.loss, float(self.scale_pos_weight))
 
 
 @jax.jit
@@ -143,7 +173,7 @@ class SoftmaxMultiClassObj(Objective):
         if name == "num_class":
             self.nclass = int(value)
 
-    def get_gradient(self, margin, info, iteration, n_rows):
+    def validate_labels(self, info) -> None:
         assert self.nclass > 0, "must set num_class to use softmax"
         def _check():
             lab = np.asarray(info.label)
@@ -151,6 +181,9 @@ class SoftmaxMultiClassObj(Objective):
                 raise ValueError(
                     f"SoftmaxMultiClassObj: label must be in [0, {self.nclass})")
         info.check_once(f"softmax_label_ok_{self.nclass}", _check)
+
+    def get_gradient(self, margin, info, iteration, n_rows):
+        self.validate_labels(info)
         return _softmax_grad(margin, info.label_dev(),
                              info.weight_dev(n_rows))
 
@@ -163,6 +196,9 @@ class SoftmaxMultiClassObj(Objective):
 
     def eval_transform(self, margin):
         return jax.nn.softmax(margin, axis=1)
+
+    def fused_grad(self):
+        return _softmax_fused
 
 
 def create_objective(name: str) -> Objective:

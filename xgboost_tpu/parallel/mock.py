@@ -73,3 +73,9 @@ def collective() -> None:
     """Call at every host-side collective entry (tree-growth launch)."""
     if _injector is not None:
         _injector.collective()
+
+
+def active() -> bool:
+    """True when fault injection is armed (fused multi-round launches
+    must fall back to per-round launches so coordinates can fire)."""
+    return _injector is not None
