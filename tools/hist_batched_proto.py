@@ -1,142 +1,20 @@
-"""Prototype: tree-batched histogram kernel for multiclass/forest rounds.
+"""Benchmark: tree-batched histogram kernel vs per-tree launches.
 
-Motivation (PROFILE.md item 3): a 6-class round grows 6 trees over the
-SAME binned matrix; vmapping the per-tree kernel rebuilds the (B, R)
-one-hot 6 times (measured slower than sequential launches).  Here the
-one-hot is built ONCE per (feature, row-tile) and contracted against a
-(R, T*2M) gh operand whose lanes pack (tree, grad/hess, node):
-
-    hist[t, b, l] = onehot[b, r] @ gh_exp[r, t*2M + l]
-
-Per-tree positions/gradients differ; the bins do not.  VPU work becomes
-independent of T; MXU work is unchanged (same FLOPs, wider lanes).
+The kernel itself lives in the package now
+(:func:`xgboost_tpu.ops.pallas_hist.build_level_histogram_pallas_batched`,
+dispatched by vmap via the custom_vmap rule in ops/histogram.py); this
+script reproduces the measurement that motivated it (PROFILE.md).
 """
-import functools
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
 
 sys.path.insert(0, ".")
 from tools.hist_microbench import timeit  # noqa: E402
 from xgboost_tpu.ops.pallas_hist import (  # noqa: E402
-    _round_up, build_level_histogram_pallas)
-
-
-def _batched_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
-                    n_bin, m_pad, f_tile, T, precision_mode):
-    """Grid step: (node_tile, feature_tile, row_tile).
-
-    binned_ref: (f_tile, R) int32
-    pos_ref:    (R, T) int32 per-tree node position (-1 inactive)
-    gh_ref:     (R, 2*T) f32 — lane t is tree t's grad, lane T+t its hess
-    out_ref:    (f_tile*n_bin, T*2*m_pad) f32
-    """
-    r_tile = binned_ref.shape[1]
-    m2 = 2 * m_pad
-    lanes = T * m2
-    m_base = pl.program_id(0) * m_pad
-
-    @pl.when(pl.program_id(2) == 0)
-    def _init():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    # lane l encodes (t, c, node): t = l // (2M), c = (l % 2M) // M,
-    # node = l % M
-    lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, lanes), 1)
-    t_of = lane // m2
-    within = lane - t_of * m2
-    node_of = m_base + jnp.where(within < m_pad, within, within - m_pad)
-    is_h = within >= m_pad
-
-    # gather per-lane gh/pos by tree id via broadcast compare over T
-    # (T is small: 2-16); builds (R, lanes) selects without lane gathers
-    gh = gh_ref[:]                                   # (R, 2T)
-    pos = pos_ref[:]                                 # (R, T)
-    ghsel = jnp.zeros((r_tile, lanes), jnp.float32)
-    possel = jnp.zeros((r_tile, lanes), jnp.int32)
-    for t in range(T):
-        sel = t_of == t
-        gval = jnp.where(is_h, gh[:, T + t:T + t + 1], gh[:, t:t + 1])
-        ghsel = jnp.where(sel, gval, ghsel)
-        possel = jnp.where(sel, pos[:, t:t + 1], possel)
-    gh_exp = jnp.where(possel == node_of, ghsel, 0.0)
-
-    if precision_mode == "fp32":
-        prec = jax.lax.Precision.HIGHEST
-        hot_dtype = jnp.float32
-    else:
-        prec = jax.lax.Precision.DEFAULT
-        hot_dtype = jnp.bfloat16
-        gh_exp = gh_exp.astype(hot_dtype)
-
-    bins = binned_ref[:]
-    bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_bin, r_tile), 0)
-    for f in range(f_tile):
-        onehot = (bins[f:f + 1, :] == bin_ids).astype(hot_dtype)
-        acc = jax.lax.dot_general(
-            onehot, gh_exp, (((1,), (0,)), ((), ())),
-            precision=prec, preferred_element_type=jnp.float32)
-        out_ref[0, f * n_bin:(f + 1) * n_bin, :] += acc
-
-
-@functools.partial(jax.jit, static_argnames=(
-    "n_node", "n_bin", "precision", "interpret", "r_tile"))
-def build_level_histogram_batched(binned, gh, pos, n_node, n_bin,
-                                  precision="bf16", interpret=False,
-                                  r_tile=1024):
-    """gh: (T, N, 2), pos: (T, N), binned: (N, F).
-    Returns (T, n_node, F, n_bin, 2) f32."""
-    T, N, _ = gh.shape
-    F = binned.shape[1]
-    m_pad = min(n_node, 64)
-    n_m_tiles = -(-n_node // m_pad)
-    lanes = T * 2 * m_pad
-    # output block (f_tile*B, lanes) f32 <= ~2MB of VMEM; the sublane
-    # rule needs f_tile to be a multiple of 8 (or the whole feature dim)
-    f_tile = max(8, min(F, (512 * 1024) // (max(n_bin, 1) *
-                                            max(lanes, 128))))
-    if f_tile < F:
-        f_tile = max(8, (f_tile // 8) * 8)
-    n_pad = _round_up(max(N, 1), r_tile)
-    f_pad = _round_up(F, f_tile)
-
-    binned_t = binned.astype(jnp.int32).T
-    if n_pad != N or f_pad != F:
-        binned_t = jnp.pad(binned_t, ((0, f_pad - F), (0, n_pad - N)))
-        gh = jnp.pad(gh, ((0, 0), (0, n_pad - N), (0, 0)))
-        pos = jnp.pad(pos, ((0, 0), (0, n_pad - N)), constant_values=-1)
-
-    # (T, N, 2) -> (N, 2T): first T lanes grads, next T hessians
-    gh_flat = jnp.concatenate([gh[..., 0].T, gh[..., 1].T], axis=1)
-    pos_t = pos.T.astype(jnp.int32)                  # (N, T)
-
-    kernel = functools.partial(_batched_kernel, n_bin=n_bin, m_pad=m_pad,
-                               f_tile=f_tile, T=T,
-                               precision_mode=precision)
-    out = pl.pallas_call(
-        kernel,
-        grid=(n_m_tiles, f_pad // f_tile, n_pad // r_tile),
-        in_specs=[
-            pl.BlockSpec((f_tile, r_tile), lambda mi, fi, ri: (fi, ri)),
-            pl.BlockSpec((r_tile, T), lambda mi, fi, ri: (ri, 0)),
-            pl.BlockSpec((r_tile, 2 * T), lambda mi, fi, ri: (ri, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, f_tile * n_bin, lanes),
-                               lambda mi, fi, ri: (mi, fi, 0)),
-        out_shape=jax.ShapeDtypeStruct((n_m_tiles, f_pad * n_bin, lanes),
-                                       jnp.float32),
-        interpret=interpret,
-    )(binned_t, pos_t, gh_flat.astype(jnp.float32))
-
-    # (m_tiles, f_pad*B, T*2M) -> (T, m_tiles*M, F, B, 2)
-    out = out.reshape(n_m_tiles, f_pad, n_bin, T, 2, m_pad)
-    out = out.transpose(3, 0, 5, 1, 2, 4).reshape(
-        T, n_m_tiles * m_pad, f_pad, n_bin, 2)
-    return out[:, :n_node, :F, :, :]
+    build_level_histogram_pallas, build_level_histogram_pallas_batched)
 
 
 def main():
@@ -147,13 +25,11 @@ def main():
     gh = jnp.asarray(rng.randn(T, n, 2), jnp.float32)
     pos = jnp.asarray(rng.randint(0, n_node, size=(T, n)), jnp.int32)
 
-    # parity (fp32 exact vs per-tree fp32 kernel; dyadic grads so f32
-    # sums are order-independent)
+    # parity on dyadic grads (f32 sums order-independent)
     ghd = jnp.asarray(rng.randint(-512, 512, (T, 4096, 2)) / 256.0,
                       jnp.float32)
-    got = np.asarray(build_level_histogram_batched(
-        binned[:4096], ghd, pos[:, :4096], n_node, b,
-        precision="fp32"))
+    got = np.asarray(build_level_histogram_pallas_batched(
+        binned[:4096], ghd, pos[:, :4096], n_node, b, precision="fp32"))
     for t in range(T):
         ref = np.asarray(build_level_histogram_pallas(
             binned[:4096], ghd[t], pos[t, :4096], n_node, b,
@@ -170,13 +46,9 @@ def main():
     seq = jax.jit(per_tree)
     ms = timeit(seq, binned, gh, pos)
     print(f"per-tree x{T} (sequential kernels): {ms:7.2f} ms")
-    for r in (1024, 2048):
-        try:
-            ms = timeit(build_level_histogram_batched, binned, gh, pos,
-                        n_node, b, r_tile=r)
-            print(f"batched shared-onehot r={r:5d}   : {ms:7.2f} ms")
-        except Exception as e:
-            print(f"batched r={r}: FAILED {str(e)[:80]}")
+    ms = timeit(build_level_histogram_pallas_batched, binned, gh, pos,
+                n_node, b, "bf16")
+    print(f"batched shared-onehot           : {ms:7.2f} ms")
 
 
 if __name__ == "__main__":

@@ -163,9 +163,12 @@ class GBTree:
         # ensemble parallelism (SURVEY.md §2.4.5): all class-group x
         # parallel trees of the round can grow in ONE vmapped launch.
         # Default on for CPU/other backends (one compile, one dispatch);
-        # off on TPU, where XLA pipelines the independent sequential
-        # launches better than vmap lowers the batched Pallas histogram
-        # (measured 240 vs 506 ms/round on 6-class 200k x 20).
+        # off on TPU: even with the tree-batched shared-onehot histogram
+        # kernel (ops/pallas_hist.build_level_histogram_pallas_batched,
+        # wired in via custom_vmap — 1.5x the kernel alone), the fully
+        # vmapped grower measures ~2x slower than pipelined sequential
+        # launches (305 vs 136-166 ms/round on 6-class 200k; the gap is
+        # spread across batched routing gathers and scatters, PROFILE.md).
         # XGBTPU_VMAP_BOOST=1 forces it on, XGBTPU_SEQ_BOOST=1 off.
         use_vmap = (jax.default_backend() != "tpu"
                     or bool(os.environ.get("XGBTPU_VMAP_BOOST")))

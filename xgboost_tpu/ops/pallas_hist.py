@@ -165,6 +165,154 @@ def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
     return out[:n_node, :F, :, :]
 
 
+def _batched_hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
+                         n_bin: int, m_pad: int, f_tile: int, t_tile: int,
+                         precision_mode: str):
+    """Tree-batched variant of :func:`_hist_kernel`: the (B, R) one-hot
+    is built ONCE per (feature, row tile) and contracted against a
+    (R, t_tile*2M) operand whose lane l encodes (tree, grad/hess, node):
+    t = l // 2M, hess = (l % 2M) >= M, node = l % M.  Per-tree positions
+    and gradients differ; the bins (and hence the one-hot — the VPU-
+    bound part of the kernel) do not, so a K-class round's histogram
+    cost approaches one class's instead of K's.
+
+    The tree dim is grid-tiled (grid dim 1) so lanes and the output
+    block stay VMEM-bounded at any ensemble width (num_parallel_tree
+    forests): per step only ``t_tile`` trees' lanes are resident.
+
+    binned_ref: (f_tile, R) int32;  pos_ref: (R, t_tile) int32;
+    gh_ref: (R, 2*t_tile) f32, INTERLEAVED per tree (g_t, h_t pairs) so
+    tree tiles are contiguous lane blocks;
+    out_ref: (1, 1, f_tile*n_bin, t_tile*2*m_pad) f32.
+    """
+    r_tile = binned_ref.shape[1]
+    m2 = 2 * m_pad
+    lanes = t_tile * m2
+    m_base = pl.program_id(0) * m_pad
+
+    @pl.when(pl.program_id(3) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, lanes), 1)
+    t_of = lane // m2
+    within = lane - t_of * m2
+    node_of = m_base + jnp.where(within < m_pad, within, within - m_pad)
+    is_h = within >= m_pad
+
+    # per-lane gh/pos selected by tree id via t_tile broadcast compares
+    # (tiles are small; dynamic lane gathers would serialize)
+    gh = gh_ref[:]                                   # (R, 2*t_tile)
+    pos = pos_ref[:]                                 # (R, t_tile)
+    ghsel = jnp.zeros((r_tile, lanes), jnp.float32)
+    possel = jnp.zeros((r_tile, lanes), jnp.int32)
+    for t in range(t_tile):
+        sel = t_of == t
+        gval = jnp.where(is_h, gh[:, 2 * t + 1:2 * t + 2],
+                         gh[:, 2 * t:2 * t + 1])
+        ghsel = jnp.where(sel, gval, ghsel)
+        possel = jnp.where(sel, pos[:, t:t + 1], possel)
+    gh_exp = jnp.where(possel == node_of, ghsel, 0.0)
+
+    if precision_mode == "fp32":
+        prec = jax.lax.Precision.HIGHEST
+        hot_dtype = jnp.float32
+    else:
+        prec = jax.lax.Precision.DEFAULT
+        hot_dtype = jnp.bfloat16
+        gh_exp = gh_exp.astype(hot_dtype)
+
+    bins = binned_ref[:]
+    bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_bin, r_tile), 0)
+    for f in range(f_tile):
+        onehot = (bins[f:f + 1, :] == bin_ids).astype(hot_dtype)
+        acc = jax.lax.dot_general(
+            onehot, gh_exp, (((1,), (0,)), ((), ())),
+            precision=prec, preferred_element_type=jnp.float32)
+        out_ref[0, 0, f * n_bin:(f + 1) * n_bin, :] += acc
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_node", "n_bin", "precision", "interpret"))
+def build_level_histogram_pallas_batched(binned: jax.Array, gh: jax.Array,
+                                         pos: jax.Array, n_node: int,
+                                         n_bin: int, precision: str = "fp32",
+                                         interpret: bool = False) -> jax.Array:
+    """Tree-batched histogram: gh (T, N, 2), pos (T, N), binned (N, F).
+
+    Returns (T, n_node, F, n_bin, 2) f32, bitwise equal (in fp32 mode)
+    to stacking T calls of :func:`build_level_histogram_pallas`.
+    Selected by the custom_vmap rule of
+    :func:`xgboost_tpu.ops.histogram.build_level_histogram`, i.e. by
+    ``jax.vmap`` of tree growth over an ensemble axis.
+    """
+    T, N, _ = gh.shape
+    F = binned.shape[1]
+    r_tile = int(os.environ.get("XGBTPU_HIST_RTILE", "2048"))
+    m_pad = min(n_node, 64)
+    n_m_tiles = -(-n_node // m_pad)
+    m2 = 2 * m_pad
+    # tile the tree dim so per-step lanes and the output block stay
+    # VMEM-bounded at ANY ensemble width: t_tile trees give lanes =
+    # t_tile*2M and an output block of f_tile*B x lanes f32 (<= ~2MB
+    # with the minimum legal f_tile of 8)
+    t_tile = max(1, min(T, max(1, 768 // m2),
+                        (2 << 20) // (8 * max(n_bin, 1) * m2 * 4)))
+    t_tiles = -(-T // t_tile)
+    T_pad = t_tiles * t_tile
+    lanes = t_tile * m2
+    # the (r_tile, lanes) gh_exp operand: cap at ~3MB of VMEM or Mosaic
+    # fails to place the kernel (seen at fp32, lanes=768, r_tile=2048)
+    esize = 4 if precision == "fp32" else 2
+    r_cap = max(512, ((3 << 20) // (max(lanes, 1) * esize)) // 512 * 512)
+    r_tile = min(r_tile, r_cap)
+    # f_tile: multiple of 8 (or the whole feature dim), output block
+    # f_tile*B x lanes f32 <= ~2MB
+    f_tile = max(8, min(F, (512 * 1024) // (max(n_bin, 1) *
+                                            max(lanes, 128))))
+    if f_tile < F:
+        f_tile = max(8, (f_tile // 8) * 8)
+    n_pad = _round_up(max(N, 1), r_tile)
+    f_pad = _round_up(F, f_tile)
+
+    binned_t = binned.astype(jnp.int32).T
+    if n_pad != N or f_pad != F or T_pad != T:
+        binned_t = jnp.pad(binned_t, ((0, f_pad - F), (0, n_pad - N)))
+        gh = jnp.pad(gh, ((0, T_pad - T), (0, n_pad - N), (0, 0)))
+        pos = jnp.pad(pos, ((0, T_pad - T), (0, n_pad - N)),
+                      constant_values=-1)
+
+    # interleaved per-tree (g, h) lane pairs so a t_tile block is one
+    # contiguous lane slice: (T, N, 2) -> (N, 2T)
+    gh_flat = gh.transpose(1, 0, 2).reshape(n_pad, 2 * T_pad)
+    pos_t = pos.T.astype(jnp.int32)                  # (N, T_pad)
+
+    kernel = functools.partial(_batched_hist_kernel, n_bin=n_bin,
+                               m_pad=m_pad, f_tile=f_tile, t_tile=t_tile,
+                               precision_mode=precision)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_m_tiles, t_tiles, f_pad // f_tile, n_pad // r_tile),
+        in_specs=[
+            pl.BlockSpec((f_tile, r_tile), lambda mi, ti, fi, ri: (fi, ri)),
+            pl.BlockSpec((r_tile, t_tile), lambda mi, ti, fi, ri: (ri, ti)),
+            pl.BlockSpec((r_tile, 2 * t_tile),
+                         lambda mi, ti, fi, ri: (ri, ti)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, f_tile * n_bin, lanes),
+                               lambda mi, ti, fi, ri: (mi, ti, fi, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_m_tiles, t_tiles, f_pad * n_bin, lanes), jnp.float32),
+        interpret=interpret,
+    )(binned_t, pos_t, gh_flat.astype(jnp.float32))
+
+    # (m_tiles, t_tiles, f_pad*B, t_tile*2M) -> (T, m_tiles*M, F, B, 2)
+    out = out.reshape(n_m_tiles, t_tiles, f_pad, n_bin, t_tile, 2, m_pad)
+    out = out.transpose(1, 4, 0, 6, 2, 3, 5).reshape(
+        T_pad, n_m_tiles * m_pad, f_pad, n_bin, 2)
+    return out[:T, :n_node, :F, :, :]
+
+
 def _nst_kernel(pos_ref, gh_ref, out_ref, *, m_pad: int):
     """Per-node (G, H) sums for one row tile: ones @ gh_exp on the MXU."""
     r_tile = pos_ref.shape[0]
