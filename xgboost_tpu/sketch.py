@@ -14,6 +14,11 @@ replacement for the reference's per-round per-node sketches
 the same summary (for on-device distributed merging over a mesh, replacing
 rabit's ``SerializeReducer``) lives in ``parallel/sketch_device.py``.
 
+The reference also ships a GK (Greenwald-Khanna, unweighted) sketch
+(``quantile.h:383-525``) that nothing in its engine instantiates — the
+weighted summary subsumes it (unweighted == all weights 1), so no
+separate GK variant exists here.
+
 Summary entries are (value, rmin, rmax, wmin):
   rmin = minimum possible rank of value  (sum of weights strictly below)
   rmax = maximum possible rank of value
