@@ -88,7 +88,14 @@ def bin_of_feature(binned: jax.Array, f_row: jax.Array) -> jax.Array:
 
 
 def _default_router(best: SplitDecision, node_of_row, binned):
-    """Row go-left decision when the split feature's bins are local."""
+    """Row go-left decision when the split feature's bins are local.
+
+    The (n_node,)-table gathers here are cheap IN-GRAPH (a gather-free
+    MXU one-hot formulation measured no faster end-to-end; PROFILE.md
+    round-2 second pass) — only `take_along_axis`-style dynamic LANE
+    gathers serialize on TPU, hence the broadcast-compare
+    :func:`bin_of_feature`.
+    """
     f_row = best.feature[node_of_row]
     j_row = best.cut_index[node_of_row]
     dl_row = best.default_left[node_of_row]
