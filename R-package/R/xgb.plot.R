@@ -1,0 +1,70 @@
+# Model visualization helpers (reference R-package/R/xgb.plot.importance.R
+# and xgb.plot.tree.R).  The reference renders with ggplot2/DiagrammeR;
+# these analogs use base graphics for the importance bars and emit
+# Graphviz DOT for trees (rendered via DiagrammeR when installed, else
+# returned/written as text) so the package has no hard plotting deps.
+
+#' Plot feature importance as a horizontal bar chart.
+#'
+#' @param importance_matrix data.frame from \code{xgb.importance}
+#' @param numberOfClusters ignored (reference clusters bars by k-means;
+#'   here bars are simply ordered by Gain)
+#' @export
+xgb.plot.importance <- function(importance_matrix = NULL,
+                                numberOfClusters = c(1:10)) {
+  if (is.null(importance_matrix) || nrow(importance_matrix) == 0) {
+    stop("importance_matrix is required (from xgb.importance)")
+  }
+  m <- importance_matrix[order(importance_matrix$Gain), ]
+  graphics::barplot(m$Gain, names.arg = m$Feature, horiz = TRUE,
+                    las = 1, main = "Feature importance (Gain)",
+                    xlab = "Gain")
+  invisible(m)
+}
+
+#' Render a boosted tree as Graphviz DOT.
+#'
+#' Returns the DOT source (invisibly); renders it when the DiagrammeR
+#' package is available, and writes it to \code{fname} when given.
+#'
+#' @param model an xgb.Booster
+#' @param fmap feature map file path (see xgb.dump)
+#' @param n_first_tree number of trees to include (default 1)
+#' @param fname optional path to write the DOT source to
+#' @export
+xgb.plot.tree <- function(model = NULL, fmap = "", n_first_tree = 1,
+                          fname = NULL) {
+  dt <- xgb.model.dt.tree(model = model, fmap = fmap)
+  dt <- dt[dt$Tree < n_first_tree, ]
+  esc <- function(x) gsub('"', '\\\\"', gsub("\\\\", "\\\\\\\\", x))
+  lines <- c("digraph xgb_tree {", "  rankdir=TB;",
+             "  node [shape=box, fontname=\"Helvetica\"];")
+  for (i in seq_len(nrow(dt))) {
+    r <- dt[i, ]
+    id <- sprintf("t%s_n%s", r$Tree, r$Node)
+    if (r$Feature == "Leaf") {
+      lines <- c(lines, sprintf(
+        "  %s [label=\"leaf=%s\", style=filled, fillcolor=lightgrey];",
+        id, r$Quality))
+    } else {
+      lines <- c(lines, sprintf(
+        "  %s [label=\"%s < %s\\ngain=%s\"];", id, esc(r$Feature),
+        r$Split, r$Quality))
+      yes_id <- sprintf("t%s_n%s", r$Tree, r$Yes)
+      no_id <- sprintf("t%s_n%s", r$Tree, r$No)
+      miss <- if (identical(r$Missing, r$Yes)) "yes, missing" else "yes"
+      lines <- c(lines,
+                 sprintf("  %s -> %s [label=\"%s\"];", id, yes_id, miss),
+                 sprintf("  %s -> %s [label=\"no\"];", id, no_id))
+    }
+  }
+  lines <- c(lines, "}")
+  dot <- paste(lines, collapse = "\n")
+  if (!is.null(fname)) {
+    writeLines(dot, fname)
+  }
+  if (requireNamespace("DiagrammeR", quietly = TRUE)) {
+    print(DiagrammeR::grViz(dot))
+  }
+  invisible(dot)
+}

@@ -150,3 +150,38 @@ def test_split_semantics_match_binning():
         left_by_value = X[:, 0] < thr
         left_by_bin = B[:, 0] <= j + 1
         np.testing.assert_array_equal(left_by_value, left_by_bin)
+
+
+def test_typed_info_accessors():
+    """Generic get/set_float_info / get/set_uint_info (reference
+    wrapper/xgboost.py:166-183)."""
+    import pytest
+    X = np.random.RandomState(0).rand(20, 3).astype(np.float32)
+    d = DMatrix(X)
+    # unset fields -> EMPTY arrays (reference parity: size==0 detects
+    # unset, unlike get_weight()'s implicit ones)
+    assert d.get_float_info("weight").size == 0
+    assert d.get_uint_info("group_ptr").size == 0
+    d.set_float_info("label", np.arange(20))
+    np.testing.assert_array_equal(d.get_float_info("label"),
+                                  np.arange(20, dtype=np.float32))
+    d.set_float_info("weight", np.full(20, 2.0))
+    np.testing.assert_array_equal(d.get_float_info("weight"),
+                                  np.full(20, 2.0, np.float32))
+    d.set_float_info("base_margin", np.full(20, 0.5))
+    assert d.get_float_info("base_margin")[0] == np.float32(0.5)
+    d.set_uint_info("root_index", np.zeros(20, np.uint32))
+    assert d.get_uint_info("root_index").dtype == np.uint32
+    assert d.get_uint_info("fold_index").size == 0  # unset -> empty
+    with pytest.raises(ValueError):
+        d.set_float_info("root_index", np.zeros(20))
+    with pytest.raises(ValueError):
+        d.get_uint_info("label")
+
+
+def test_module_exports_reference_surface():
+    """Module-level names a reference-wrapper user expects."""
+    import xgboost_tpu as m
+    for name in ("DMatrix", "Booster", "train", "cv", "mknfold", "aggcv",
+                 "CVPack", "XGBModel", "XGBClassifier", "XGBRegressor"):
+        assert hasattr(m, name), name

@@ -16,7 +16,8 @@ custom kernels are the Pallas histogram/node-stat kernels
 from xgboost_tpu.config import TrainParam
 from xgboost_tpu.data import DMatrix
 from xgboost_tpu.external import ExtMemDMatrix
-from xgboost_tpu.learner import Booster, train, cv
+from xgboost_tpu.learner import (Booster, CVPack, aggcv, cv, mknfold,
+                                 train)
 from xgboost_tpu.sklearn import XGBModel, XGBClassifier, XGBRegressor
 
 __version__ = "0.1.0"
@@ -28,6 +29,9 @@ __all__ = [
     "Booster",
     "train",
     "cv",
+    "CVPack",
+    "mknfold",
+    "aggcv",
     "XGBModel",
     "XGBClassifier",
     "XGBRegressor",

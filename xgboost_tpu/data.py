@@ -218,6 +218,42 @@ class DMatrix:
     def set_base_margin(self, margin):
         self.info.set_field("base_margin", margin)
 
+    # generic typed field accessors (reference wrapper/xgboost.py:166-183:
+    # get/set_float_info for label/weight/base_margin; get/set_uint_info
+    # for root_index/fold_index, plus read-only group_ptr)
+    _FLOAT_FIELDS = ("label", "weight", "base_margin")
+    _UINT_FIELDS = ("root_index", "fold_index")
+
+    def set_float_info(self, field: str, data) -> None:
+        if field not in self._FLOAT_FIELDS:
+            raise ValueError(f"unknown float field {field!r}")
+        self.info.set_field(field, np.asarray(data, dtype=np.float32))
+
+    def get_float_info(self, field: str) -> np.ndarray:
+        """Unset fields return an EMPTY array (reference parity: callers
+        detect unset weights via size == 0 — unlike get_weight(), which
+        materializes the implicit all-ones weights)."""
+        if field not in self._FLOAT_FIELDS:
+            raise ValueError(f"unknown float field {field!r}")
+        v = self.info.get_field(field)
+        return (np.zeros(0, np.float32) if v is None
+                else np.asarray(v, np.float32).copy())
+
+    def set_uint_info(self, field: str, data) -> None:
+        if field not in self._UINT_FIELDS:
+            raise ValueError(f"unknown uint field {field!r}")
+        self.info.set_field(field, np.asarray(data))
+
+    def get_uint_info(self, field: str) -> np.ndarray:
+        if field == "group_ptr":  # read-only: set via set_group (sizes)
+            v = self.info.group_ptr
+        elif field in self._UINT_FIELDS:
+            v = self.info.get_field(field)
+        else:
+            raise ValueError(f"unknown uint field {field!r}")
+        return (np.zeros(0, np.uint32) if v is None
+                else np.asarray(v, np.uint32).copy())
+
     def get_label(self):
         # a copy: in-place mutation of the returned array would bypass
         # MetaInfo's device-cache invalidation (set via set_field only)
