@@ -52,10 +52,11 @@ xgb.plot.tree <- function(model = NULL, fmap = "", n_first_tree = 1,
         r$Split, r$Quality))
       yes_id <- sprintf("t%s_n%s", r$Tree, r$Yes)
       no_id <- sprintf("t%s_n%s", r$Tree, r$No)
-      miss <- if (identical(r$Missing, r$Yes)) "yes, missing" else "yes"
+      yes_lab <- if (identical(r$Missing, r$Yes)) "yes, missing" else "yes"
+      no_lab <- if (identical(r$Missing, r$No)) "no, missing" else "no"
       lines <- c(lines,
-                 sprintf("  %s -> %s [label=\"%s\"];", id, yes_id, miss),
-                 sprintf("  %s -> %s [label=\"no\"];", id, no_id))
+                 sprintf("  %s -> %s [label=\"%s\"];", id, yes_id, yes_lab),
+                 sprintf("  %s -> %s [label=\"%s\"];", id, no_id, no_lab))
     }
   }
   lines <- c(lines, "}")

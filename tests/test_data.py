@@ -185,3 +185,13 @@ def test_module_exports_reference_surface():
     for name in ("DMatrix", "Booster", "train", "cv", "mknfold", "aggcv",
                  "CVPack", "XGBModel", "XGBClassifier", "XGBRegressor"):
         assert hasattr(m, name), name
+
+
+def test_set_uint_info_rejects_bad_values():
+    import pytest
+    X = np.zeros((4, 2), np.float32)
+    d = DMatrix(X)
+    with pytest.raises(ValueError):
+        d.set_uint_info("root_index", np.array([-1, 0, 0, 0]))
+    with pytest.raises(ValueError):
+        d.set_uint_info("fold_index", np.array([0.5, 1, 2, 3]))

@@ -242,7 +242,14 @@ class DMatrix:
     def set_uint_info(self, field: str, data) -> None:
         if field not in self._UINT_FIELDS:
             raise ValueError(f"unknown uint field {field!r}")
-        self.info.set_field(field, np.asarray(data))
+        arr = np.asarray(data)
+        if arr.size and (not np.issubdtype(arr.dtype, np.integer)
+                         or int(arr.min()) < 0
+                         or int(arr.max()) > np.iinfo(np.int32).max):
+            raise ValueError(
+                f"set_uint_info({field!r}): values must be non-negative "
+                "integers < 2**31 (stored as int32)")
+        self.info.set_field(field, arr)
 
     def get_uint_info(self, field: str) -> np.ndarray:
         if field == "group_ptr":  # read-only: set via set_group (sizes)

@@ -15,6 +15,7 @@ the reference's pred_buffer/pred_counter design
 from __future__ import annotations
 
 import json
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -403,6 +404,9 @@ class Booster:
             and not entry.external
             and self._col_mesh is None
             and not mock.active()
+            # escape hatch: sequential per-round launches (the fused
+            # scan always grows the round's ensemble vmapped)
+            and not os.environ.get("XGBTPU_SEQ_BOOST")
             and self.profiler is None
             and not (self.param.gamma > 0.0 and "prune" in ups)
             and "refresh" not in ups

@@ -38,6 +38,30 @@ def make_grow_config(p: TrainParam, n_bin: int) -> GrowConfig:
                       colsample_bylevel=p.colsample_bylevel)
 
 
+@functools.partial(jax.jit, static_argnames=("t",))
+def _unstack_trees(stacked, t: int):
+    """Slice a (T, ...) tree stack into a tuple of per-tree pytrees in
+    ONE device launch.  Doing this as T x n_fields eager ops costs a
+    dispatch each — through a tunnel-attached TPU that serialized into
+    hundreds of ms per boosting round (measured; PROFILE.md)."""
+    return tuple(jax.tree.map(lambda x: x[i], stacked) for i in range(t))
+
+
+@functools.partial(jax.jit, static_argnames=("K", "npar", "masked"))
+def _vmapped_deltas(stacked, row_leafs, row_valid, K: int, npar: int,
+                    masked: bool):
+    """Margin deltas of a vmapped growth launch: per-tree leaf-value
+    gathers + per-class accumulation, fused into one launch."""
+    N = row_leafs.shape[1]
+    deltas = jnp.zeros((N, K), jnp.float32)
+    for i in range(K * npar):
+        d = stacked.leaf_value[i][row_leafs[i]]
+        if masked:
+            d = d * row_valid.astype(d.dtype)
+        deltas = deltas.at[:, i // npar].add(d)
+    return deltas
+
+
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "K", "npar", "cfg", "split_finder", "grad_fn", "mesh"))
 def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
@@ -50,32 +74,45 @@ def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
 
     Returns (final margin (N, K), stacked trees (n_rounds, K*npar, ...)).
     """
+    T_pr = K * npar
+
+    def grow_one(tkey, gh2):
+        if mesh is not None:
+            from xgboost_tpu.parallel.dp import grow_tree_dp
+            rv = (row_valid if row_valid is not None
+                  else jnp.ones(binned.shape[0], jnp.bool_))
+            tree, row_leaf, d = grow_tree_dp(
+                mesh, tkey, binned, gh2, cut_values, n_cuts, cfg, rv,
+                split_finder=split_finder)
+        else:
+            tree, row_leaf = grow_tree(
+                tkey, binned, gh2, cut_values, n_cuts, cfg, row_valid,
+                split_finder=split_finder)
+            d = tree.leaf_value[row_leaf]
+        if row_valid is not None:
+            d = d * row_valid.astype(d.dtype)
+        return tree, d
+
     def body(margin, i):
         key = jax.random.fold_in(base_key, i)
         gh = grad_fn(margin, label, weight, i)           # (N, K, 2)
-        trees = []
-        delta = jnp.zeros_like(margin)
-        for k in range(K):
-            for t in range(npar):
-                tkey = jax.random.fold_in(key, k * npar + t)
-                if mesh is not None:
-                    from xgboost_tpu.parallel.dp import grow_tree_dp
-                    rv = (row_valid if row_valid is not None
-                          else jnp.ones(binned.shape[0], jnp.bool_))
-                    tree, row_leaf, d = grow_tree_dp(
-                        mesh, tkey, binned, gh[:, k, :], cut_values,
-                        n_cuts, cfg, rv, split_finder=split_finder)
-                else:
-                    tree, row_leaf = grow_tree(
-                        tkey, binned, gh[:, k, :], cut_values, n_cuts,
-                        cfg, row_valid, split_finder=split_finder)
-                    d = tree.leaf_value[row_leaf]
-                if row_valid is not None:
-                    d = d * row_valid.astype(d.dtype)
-                delta = delta.at[:, k].add(d)
-                trees.append(tree)
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
-        return margin + delta, stacked
+        if T_pr > 1:
+            # ensemble axis vmapped: the batched shared-onehot histogram
+            # kernel + broadcast-compare lookups make this the fast path
+            # (same per-tree keys as the sequential loop — bit-matched)
+            tkeys = jnp.stack([jax.random.fold_in(key, j)
+                               for j in range(T_pr)])
+            gh_t = jnp.take(gh, jnp.asarray(
+                [j // npar for j in range(T_pr)], jnp.int32),
+                axis=1).transpose(1, 0, 2)               # (T, N, 2)
+            stacked, ds = jax.vmap(grow_one)(tkeys, gh_t)
+            delta = jnp.zeros_like(margin)
+            for j in range(T_pr):
+                delta = delta.at[:, j // npar].add(ds[j])
+            return margin + delta, stacked
+        tree, d = grow_one(jax.random.fold_in(key, 0), gh[:, 0, :])
+        stacked = jax.tree.map(lambda x: x[None], tree)
+        return margin + d[:, None], stacked
 
     iters = first_iteration + jnp.arange(n_rounds)
     return jax.lax.scan(body, margin, iters)
@@ -161,18 +198,15 @@ class GBTree:
         from xgboost_tpu.parallel import mock
         import os
         # ensemble parallelism (SURVEY.md §2.4.5): all class-group x
-        # parallel trees of the round can grow in ONE vmapped launch.
-        # Default on for CPU/other backends (one compile, one dispatch);
-        # off on TPU: even with the tree-batched shared-onehot histogram
-        # kernel (ops/pallas_hist.build_level_histogram_pallas_batched,
-        # wired in via custom_vmap — 1.5x the kernel alone), the fully
-        # vmapped grower measures ~2x slower than pipelined sequential
-        # launches (305 vs 136-166 ms/round on 6-class 200k; the gap is
-        # spread across batched routing gathers and scatters, PROFILE.md).
-        # XGBTPU_VMAP_BOOST=1 forces it on, XGBTPU_SEQ_BOOST=1 off.
-        use_vmap = (jax.default_backend() != "tpu"
-                    or bool(os.environ.get("XGBTPU_VMAP_BOOST")))
-        if (col_mesh is None and K * npar > 1 and use_vmap
+        # parallel trees of the round grow in ONE vmapped launch.  The
+        # vmapped grower beats pipelined sequential launches on TPU
+        # (70 vs 85 ms on 6-class 200k) now that (a) jax.vmap of the
+        # level histogram dispatches to the tree-batched shared-onehot
+        # kernel via custom_vmap (ops/histogram.py) and (b) the per-row
+        # small-table lookups batch as broadcast-compare selects instead
+        # of ~12 ms kCustom gathers (tree.table_lookup; PROFILE.md).
+        # XGBTPU_SEQ_BOOST=1 restores sequential launches.
+        if (col_mesh is None and K * npar > 1
                 and not os.environ.get("XGBTPU_SEQ_BOOST")):
             return self._do_boost_vmapped(binned, gh, key, row_valid, mesh,
                                           K, npar, do_prune)
@@ -274,23 +308,33 @@ class GBTree:
             stacked, row_leafs = jax.vmap(one)(keys, gh_t)
             ds = None
 
-        new_trees: List[TreeArrays] = []
-        deltas = jnp.zeros((binned.shape[0], K), jnp.float32)
-        for i in range(T):
-            tree = jax.tree.map(lambda x: x[i], stacked)
-            if do_prune:
-                tree, resolve = prune_tree(tree, self.param.gamma)
+        new_trees = list(_unstack_trees(stacked, T))
+        if do_prune:
+            # pruning is host-side per tree; the delta re-gather stays
+            # eager (prune runs only when gamma > 0)
+            deltas = jnp.zeros((binned.shape[0], K), jnp.float32)
+            for i in range(T):
+                tree, resolve = prune_tree(new_trees[i], self.param.gamma)
                 d = tree.leaf_value[jnp.asarray(resolve)[row_leafs[i]]]
-            elif ds is not None:
+                if row_valid is not None:
+                    d = d * row_valid.astype(d.dtype)
+                new_trees[i] = tree
+                deltas = deltas.at[:, i // npar].add(d)
+        elif ds is not None:
+            deltas = jnp.zeros((binned.shape[0], K), jnp.float32)
+            for i in range(T):
                 d = ds[i]
-            else:
-                d = tree.leaf_value[row_leafs[i]]
-            if row_valid is not None:
-                d = d * row_valid.astype(d.dtype)
-            new_trees.append(tree)
+                if row_valid is not None:
+                    d = d * row_valid.astype(d.dtype)
+                deltas = deltas.at[:, i // npar].add(d)
+        else:
+            rv = (row_valid if row_valid is not None
+                  else jnp.ones((), jnp.bool_))
+            deltas = _vmapped_deltas(stacked, row_leafs, rv, K, npar,
+                                     row_valid is not None)
+        for i, tree in enumerate(new_trees):
             self.trees.append(tree)
             self.tree_group.append(i // npar)
-            deltas = deltas.at[:, i // npar].add(d)
         self._stack_cache = None
         return new_trees, deltas
 
@@ -355,8 +399,7 @@ class GBTree:
             full = flat
             full_group = jnp.asarray(group_new, jnp.int32)
         T_new = n_rounds * K * npar
-        self.trees.extend(jax.tree.map(lambda x: x[j], flat)
-                          for j in range(T_new))
+        self.trees.extend(_unstack_trees(flat, T_new))
         self.tree_group.extend(group_new)
         self._stack_cache = (len(self.trees), full, full_group)
         return margin_f

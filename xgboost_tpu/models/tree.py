@@ -75,6 +75,47 @@ def _default_split_finder(hist, nst, n_cuts, cut_values, fmask, split_cfg):
                          jnp.zeros_like(best.feature))
 
 
+def _onehot_select(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """``table[..., idx]`` via broadcast-compare (no gather): table
+    (..., M) indexed by idx (..., N) -> (..., N); M is small."""
+    M = table.shape[-1]
+    ids = jnp.arange(M, dtype=jnp.int32)
+    sel = idx[..., :, None] == ids
+    tb = table[..., None, :]
+    if table.dtype == jnp.bool_:
+        return (sel & tb).any(axis=-1)
+    return jnp.where(sel, tb, jnp.zeros((), table.dtype)).sum(axis=-1)
+
+
+from jax.custom_batching import custom_vmap  # noqa: E402 (used below)
+
+
+@custom_vmap
+def table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """Per-row lookup in a small per-node table: ``table[idx]``.
+
+    Unbatched, XLA lowers this gather well.  Under ``jax.vmap`` (the
+    ensemble axis of vmapped growth) the batched gather lowers to a
+    ~12 ms/launch kCustom kernel on TPU — the dominant cost of the
+    vmapped grower (profiled; PROFILE.md round-2 second pass) — so the
+    batching rule swaps in a broadcast-compare select, which fuses.
+    """
+    return table[idx]
+
+
+@table_lookup.def_vmap
+def _table_lookup_vmap(axis_size, in_batched, table, idx):
+    tb, ib = in_batched
+    table_b = table if tb else jnp.broadcast_to(
+        table, (axis_size,) + table.shape)
+    idx_b = idx if ib else jnp.broadcast_to(idx, (axis_size,) + idx.shape)
+    if table_b.shape[-1] > 1024:
+        # the O(N*M) compare stops paying for big tables (deep trees,
+        # CPU backends); the batched gather is the lesser evil there
+        return jnp.take_along_axis(table_b, idx_b, axis=-1), True
+    return _onehot_select(table_b, idx_b), True
+
+
 def bin_of_feature(binned: jax.Array, f_row: jax.Array) -> jax.Array:
     """Per-row bin id of a per-row feature: ``binned[r, f_row[r]]``.
 
@@ -90,15 +131,16 @@ def bin_of_feature(binned: jax.Array, f_row: jax.Array) -> jax.Array:
 def _default_router(best: SplitDecision, node_of_row, binned):
     """Row go-left decision when the split feature's bins are local.
 
-    The (n_node,)-table gathers here are cheap IN-GRAPH (a gather-free
-    MXU one-hot formulation measured no faster end-to-end; PROFILE.md
-    round-2 second pass) — only `take_along_axis`-style dynamic LANE
-    gathers serialize on TPU, hence the broadcast-compare
-    :func:`bin_of_feature`.
+    The (n_node,)-table lookups are cheap in-graph when unbatched (a
+    gather-free MXU formulation measured no faster end-to-end), but
+    catastrophic as vmap-batched gathers — :func:`table_lookup` picks
+    the right lowering per context.  Only `take_along_axis`-style
+    dynamic LANE gathers always serialize on TPU, hence the
+    broadcast-compare :func:`bin_of_feature`.
     """
-    f_row = best.feature[node_of_row]
-    j_row = best.cut_index[node_of_row]
-    dl_row = best.default_left[node_of_row]
+    f_row = table_lookup(best.feature, node_of_row)
+    j_row = table_lookup(best.cut_index, node_of_row)
+    dl_row = table_lookup(best.default_left, node_of_row)
     b = bin_of_feature(binned, f_row)
     return jnp.where(b == 0, dl_row, b <= j_row + 1)
 
@@ -202,7 +244,7 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
         # park rows whose node became a leaf; route the rest to children
         active = pos >= 0
         node_of_row = jnp.clip(pos, 0, n_node - 1)
-        row_is_leaf = active & make_leaf[node_of_row]
+        row_is_leaf = active & table_lookup(make_leaf, node_of_row)
         row_leaf = jnp.where(row_is_leaf, base + pos, row_leaf)
         if best is not None:
             go_left = router(best, node_of_row, binned)

@@ -118,7 +118,9 @@ def find_best_splits(hist: jax.Array, nstats: jax.Array, n_cuts: jax.Array,
 
     flat = loss_chg.reshape(n_node, F * C * 2)
     best = jnp.argmax(flat, axis=1)     # first max -> lowest fid (tie-break)
-    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    # max() rather than flat[best]: the gather is slow as a vmap-batched
+    # op on TPU, and max/argmax scan the same array
+    best_gain = flat.max(axis=1)
     feature = (best // (C * 2)).astype(jnp.int32)
     cut_index = ((best // 2) % C).astype(jnp.int32)
     default_left = (best % 2).astype(jnp.bool_)
