@@ -112,3 +112,26 @@ def test_stdin_data_loading(monkeypatch):
     d = xgb.DMatrix("stdin")
     assert d.num_row == 3 and d.num_col == 3
     np.testing.assert_array_equal(d.get_label(), [1, 0, 1])
+
+
+def test_cli_fused_no_evals_matches_evald_run(svm_data):
+    """With no eval sets / save_period / checkpoints, task_train fuses
+    the round loop into one launch; the model must equal the eval'd
+    (per-round) run's bitwise."""
+    tp, train, test, _ = svm_data
+    import xgboost_tpu as xgb
+    conf_seq = _conf(tp, train, test,
+                     model_out=str(tp / "seq.model"))
+    assert cli_main([str(conf_seq)]) == 0
+    from pathlib import Path
+    conf_fused = Path(_conf(tp, train, test,
+                            model_out=str(tp / "fused.model")))
+    # drop the eval set -> fused eligibility
+    text = conf_fused.read_text().replace(f"eval[test] = {test}\n", "")
+    conf_fused.write_text(text)
+    assert cli_main([str(conf_fused)]) == 0
+    b1 = xgb.Booster(model_file=str(tp / "seq.model"))
+    b2 = xgb.Booster(model_file=str(tp / "fused.model"))
+    s1, s2 = b1.gbtree.get_state(), b2.gbtree.get_state()
+    for k in s1:
+        np.testing.assert_array_equal(s1[k], s2[k], err_msg=k)

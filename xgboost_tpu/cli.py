@@ -224,6 +224,27 @@ class BoostLearnTask:
         bst.save_model(path, save_base64=bool(self.save_base64))
 
     # ------------------------------------------------------------- train
+    def _train_rounds(self, bst, data, evals, start_round: int,
+                      start: float) -> None:
+        """Per-round loop: eval lines, periodic saves, checkpoints
+        (reference TaskTrain round loop, xgboost_main.cpp:175-229)."""
+        for i in range(start_round, self.num_round):
+            if not self.silent:
+                print(f"boosting round {i}, {time.time() - start:.0f} sec "
+                      "elapsed", file=sys.stderr)
+            bst.update(data, i)
+            if evals:
+                from contextlib import nullcontext
+                prof = bst.profiler
+                with prof.phase("eval") if prof else nullcontext():
+                    msg = bst.eval_set(evals, i)
+                if self.silent < 2:
+                    print(msg, file=sys.stderr)
+            if self.save_period != 0 and (i + 1) % self.save_period == 0:
+                self._save(bst, i)
+            if self.checkpoint_dir and self.rank == 0:
+                _save_checkpoint(self.checkpoint_dir, bst, i + 1)
+
     def task_train(self) -> int:
         import xgboost_tpu  # noqa: F401  (ensure package import works early)
 
@@ -249,22 +270,21 @@ class BoostLearnTask:
                     bst, start_round, self.rank, self._params_dict())
 
         start = time.time()
-        for i in range(start_round, self.num_round):
+        # nothing runs on the host between rounds (no eval lines, no
+        # periodic saves, no per-round checkpoint): fuse the whole round
+        # loop into one device launch (update_many falls back per-round
+        # when ineligible — mock, pruning, external memory, ...)
+        if (not evals and self.save_period == 0
+                and not self.checkpoint_dir):
             if not self.silent:
-                print(f"boosting round {i}, {time.time() - start:.0f} sec "
-                      "elapsed", file=sys.stderr)
-            bst.update(data, i)
-            if evals:
-                from contextlib import nullcontext
-                prof = bst.profiler
-                with prof.phase("eval") if prof else nullcontext():
-                    msg = bst.eval_set(evals, i)
-                if self.silent < 2:
-                    print(msg, file=sys.stderr)
-            if self.save_period != 0 and (i + 1) % self.save_period == 0:
-                self._save(bst, i)
-            if self.checkpoint_dir and self.rank == 0:
-                _save_checkpoint(self.checkpoint_dir, bst, i + 1)
+                # the per-round progress lines don't exist in a fused
+                # launch; say so once (liveness signal for long jobs)
+                print(f"fusing rounds {start_round}..{self.num_round - 1} "
+                      "into one device launch", file=sys.stderr)
+            bst.update_many(data, start_round,
+                            self.num_round - start_round)
+        else:
+            self._train_rounds(bst, data, evals, start_round, start)
         # save final round unless a periodic numbered save already covered
         # it (reference xgboost_main.cpp:219-225: no final save when
         # save_period divides num_round, even with model_out set)
