@@ -18,7 +18,12 @@ TPU-native shape of the same idea (SURVEY.md §5.7):
      histograms col-batch by col-batch for the same reason,
      ``updater_histmaker-inl.hpp:296-348``).
 
-Margins, labels and gradients are (N,)-sized and stay in host RAM.
+Margins, gradients and deltas are (N,)-sized — tiny next to the paged
+O(N·F) data — and stay DEVICE-resident (host round trips cost seconds
+per round on tunnel-attached chips).  When the whole binned matrix fits
+the device budget (``fits_device_budget``), the learner skips streaming
+entirely and trains through the in-memory fast path; only genuinely
+over-budget matrices stream batches host→device.
 """
 
 from __future__ import annotations
@@ -318,6 +323,26 @@ class ExtMemDMatrix:
         for start in range(0, self.num_row, step):
             yield start, np.asarray(self._binned_mm[start:start + step])
 
+    def fits_device_budget(self) -> bool:
+        """True when the whole binned matrix fits the device budget
+        (``XGTPU_EXT_DEVICE_CACHE_MB``, default 2048).  The learner then
+        trains through the in-memory fast path — external memory has
+        done its job bounding INGEST/sketch/quantize memory — and only
+        genuinely over-budget matrices stream batches (the out-of-HBM
+        guarantee: working set is one batch)."""
+        assert self._binned_mm is not None, "call build_binned first"
+        budget = int(os.environ.get(
+            "XGTPU_EXT_DEVICE_CACHE_MB", "2048")) << 20
+        total = (self.num_row * self._binned_mm.shape[1]
+                 * self._binned_mm.dtype.itemsize)
+        return total <= budget
+
+    def device_batches(self):
+        """Yield (row_start, binned_device) batches (streaming; the
+        in-budget case never reaches here — see fits_device_budget)."""
+        for start, b in self.binned_batches():
+            yield start, jnp.asarray(b)
+
 
 # ------------------------------------------------------------- paged grow
 @functools.partial(jax.jit, static_argnames=("depth", "n_bin"))
@@ -381,9 +406,9 @@ def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
     batches (distributed external memory: SURVEY.md §5.7 item 2 composed
     with §2.4.2).
 
-    gh: (N, 2) host gradients.  Row subsampling uses a host-side
-    deterministic draw.  Returns the grown tree (delta is computed by the
-    caller via :func:`_paged_leaf_delta` batch by batch).
+    gh: (N, 2) gradients (device or host).  Row subsampling uses a
+    deterministic device-side draw.  Returns the grown tree (delta is
+    computed by the caller via :func:`_paged_leaf_delta` batch by batch).
     """
     from xgboost_tpu.models.tree import (_default_split_finder,
                                          _sample_features)
@@ -392,11 +417,13 @@ def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
         split_finder = _default_split_finder
 
     key_rows, key_ftree, key_flevel = jax.random.split(key, 3)
-    gh_used = gh
+    # gradients are O(N) (not O(N*F)) and stay device-resident; the
+    # per-batch host uploads they replaced were the dominant cost of
+    # paged training on tunnel-attached chips
+    gh_dev = jnp.asarray(gh, jnp.float32)
     if cfg.subsample < 1.0:
-        keep = np.asarray(
-            jax.random.uniform(key_rows, (dmat.num_row,))) < cfg.subsample
-        gh_used = gh * keep[:, None].astype(np.float32)
+        keep = jax.random.uniform(key_rows, (dmat.num_row,)) < cfg.subsample
+        gh_dev = gh_dev * keep[:, None].astype(jnp.float32)
 
     F = int(n_cuts.shape[0])
     fmask_tree = _sample_features(key_ftree, F, cfg.colsample_bytree)
@@ -406,20 +433,18 @@ def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
         n_node = 1 << depth
         hist = None
         nst = None
-        for start, batch in dmat.binned_batches():
-            bgh = gh_used[start:start + batch.shape[0]]
+        for start, batch in dmat.device_batches():
+            bgh = gh_dev[start:start + batch.shape[0]]
             if mesh is not None:
                 pad = (-batch.shape[0]) % mesh.devices.size
                 if pad:
-                    batch = np.pad(batch, ((0, pad), (0, 0)))
-                    bgh = np.concatenate(
-                        [bgh, np.zeros((pad, 2), np.float32)])
+                    batch = jnp.pad(batch, ((0, pad), (0, 0)))
+                    bgh = jnp.pad(bgh, ((0, pad), (0, 0)))
                 h, s = _paged_level_hist_dp(
-                    mesh, tree, jnp.asarray(batch), jnp.asarray(bgh),
-                    depth, cfg.n_bin)
+                    mesh, tree, batch, bgh, depth, cfg.n_bin)
             else:
-                h, s = _paged_level_hist(tree, jnp.asarray(batch),
-                                         jnp.asarray(bgh), depth, cfg.n_bin)
+                h, s = _paged_level_hist(tree, batch, bgh, depth,
+                                         cfg.n_bin)
             hist = h if hist is None else hist + h
             nst = s if nst is None else nst + s
         if depth == cfg.max_depth:

@@ -221,18 +221,36 @@ class Booster:
         # this model's cut indices against another model's bins
         if dmat._binned_mm is None or dmat._binned_cuts is not self.gbtree.cuts:
             dmat.build_binned(self.gbtree.cuts)
+        # when the whole binned matrix fits the device budget, external
+        # memory has done its job (bounded INGEST/sketch/quantize memory)
+        # and training can take the in-memory fast path — one launch per
+        # tree (or per fused run) instead of per (level, batch).  The
+        # reference's HalfRAM variant is the same idea one level down
+        # (page_dmatrix-inl.hpp:230-245: rows on disk, working set in
+        # RAM); here the working set is the binned matrix in HBM.
+        if dmat.fits_device_budget():
+            binned_np = np.asarray(dmat._binned_mm)
+            if self._mesh is not None:
+                return self._make_sharded_entry(dmat, binned_np=binned_np)
+            return _CacheEntry(
+                dmat, jnp.asarray(binned_np),
+                jnp.asarray(self._base_margin_of(dmat, dmat.num_row)))
         return _CacheEntry(
             dmat, None, np.asarray(self._base_margin_of(dmat, dmat.num_row)),
             external=True)
 
-    def _make_sharded_entry(self, dmat: DMatrix) -> _CacheEntry:
+    def _make_sharded_entry(self, dmat: DMatrix,
+                            binned_np: Optional[np.ndarray] = None
+                            ) -> _CacheEntry:
         """Pad rows to the mesh size and shard over the 'data' axis (the
         reference's per-rank row-shard loading, simple_dmatrix-inl.hpp:89-96,
-        realized as device placement under one controller)."""
+        realized as device placement under one controller).  ``binned_np``
+        skips re-binning (in-budget external matrices pass their memmap)."""
         from xgboost_tpu.parallel.dp import shard_rows
         n = dmat.num_row
         pad = (-n) % self._mesh.size
-        binned_np = bin_matrix(dmat, self.gbtree.cuts)
+        if binned_np is None:
+            binned_np = bin_matrix(dmat, self.gbtree.cuts)
         if pad:
             binned_np = np.pad(binned_np, ((0, pad), (0, 0)))
         # host numpy -> global sharding directly: in multi-process mode
@@ -294,25 +312,31 @@ class Booster:
             entry.applied += len(chunk)
 
     def _sync_margin_ext(self, entry: _CacheEntry):
-        """Host-side margin for an external-memory matrix, rebuilt by
-        streaming binned batches through the not-yet-applied trees."""
+        """Margin for an external-memory matrix, rebuilt by streaming
+        binned batches through the not-yet-applied trees.
+
+        The margin is DEVICE-resident (it is O(N), tiny next to the
+        paged O(N*F) data): round-tripping it through the host cost
+        seconds per round on tunnel-attached chips (PROFILE.md)."""
         if entry.margin is None:
-            entry.margin = np.broadcast_to(
-                entry.base, (entry.n_real, self._K)).astype(np.float32).copy()
+            entry.margin = jnp.broadcast_to(
+                jnp.asarray(entry.base),
+                (entry.n_real, self._K)).astype(jnp.float32)
             entry.applied = 0
         if entry.applied >= self.gbtree.num_trees:
             return
-        import jax.numpy as _jnp
         from xgboost_tpu.models.tree import predict_margin_binned
         chunk_trees = self.gbtree.trees[entry.applied:]
         groups = self.gbtree.tree_group[entry.applied:]
-        stack = jax.tree.map(lambda *xs: _jnp.stack(xs), *chunk_trees)
-        group = _jnp.asarray(groups, _jnp.int32)
-        for start, batch in entry.dmat.binned_batches():
-            m = predict_margin_binned(
-                stack, group, _jnp.asarray(batch), _jnp.zeros((), _jnp.float32),
-                self.gbtree.cfg.max_depth, self._K)
-            entry.margin[start:start + batch.shape[0]] += np.asarray(m)
+        stack = jax.tree.map(lambda *xs: jnp.stack(xs), *chunk_trees)
+        group = jnp.asarray(groups, jnp.int32)
+        # batches are contiguous ordered row ranges: one concat + one
+        # add instead of a full-margin scatter per batch
+        parts = [predict_margin_binned(
+                     stack, group, batch, jnp.zeros((), jnp.float32),
+                     self.gbtree.cfg.max_depth, self._K)
+                 for _, batch in entry.dmat.device_batches()]
+        entry.margin = jnp.asarray(entry.margin) + jnp.concatenate(parts)
         entry.applied = self.gbtree.num_trees
 
     # ------------------------------------------------------------ profiling
@@ -433,7 +457,7 @@ class Booster:
         g = np.asarray(grad, np.float32).reshape(dtrain.num_row, self._K)
         h = np.asarray(hess, np.float32).reshape(dtrain.num_row, self._K)
         n_dev = (entry.binned.shape[0] if entry.binned is not None
-                 else entry.margin.shape[0])  # external: margin is host-side
+                 else entry.margin.shape[0])  # external: no binned array
         pad = n_dev - dtrain.num_row
         if pad:  # zero-gradient padding rows (dsplit=row sharding)
             g = np.concatenate([g, np.zeros((pad, self._K), np.float32)])
@@ -467,9 +491,9 @@ class Booster:
                 raise NotImplementedError(
                     "updater=refresh is not supported on external-memory "
                     "matrices")
-            deltas = self.gbtree.do_boost_paged(entry.dmat, np.asarray(gh),
-                                                key, mesh=self._mesh)
-            entry.margin += deltas
+            deltas = self.gbtree.do_boost_paged(entry.dmat, gh, key,
+                                                mesh=self._mesh)
+            entry.margin = jnp.asarray(entry.margin) + deltas
             entry.applied = self.gbtree.num_trees
             return
         grows = any(u.startswith("grow") or u == "distcol" for u in ups)
@@ -515,8 +539,8 @@ class Booster:
         if cached is not None and cached.external:
             if pred_leaf:
                 leaves = [np.asarray(self.gbtree.predict_leaf(
-                    jnp.asarray(batch), ntree_limit))
-                    for _, batch in data.binned_batches()]
+                    batch, ntree_limit))
+                    for _, batch in data.device_batches()]
                 return np.concatenate(leaves, axis=0)
             if ntree_limit == 0:
                 self._sync_margin(cached)
@@ -524,10 +548,10 @@ class Booster:
             else:
                 margin = np.concatenate(
                     [np.asarray(self.gbtree.predict_margin(
-                        jnp.asarray(batch),
+                        batch,
                         np.asarray(cached.base)[s:s + batch.shape[0]],
                         ntree_limit))
-                     for s, batch in data.binned_batches()], axis=0)
+                     for s, batch in data.device_batches()], axis=0)
             out = np.asarray(self.obj.pred_transform(
                 jnp.asarray(margin), output_margin=output_margin))
             if out.ndim == 2 and out.shape[1] == 1:

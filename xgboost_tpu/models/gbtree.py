@@ -405,13 +405,15 @@ class GBTree:
         return margin_f
 
     # ----------------------------------------------------------- paged boost
-    def do_boost_paged(self, dmat, gh: np.ndarray, key: jax.Array,
-                       mesh=None) -> np.ndarray:
+    def do_boost_paged(self, dmat, gh, key: jax.Array,
+                       mesh=None) -> jax.Array:
         """One boosting round over an external-memory matrix: histograms
-        accumulate batch-by-batch (SURVEY.md §5.7), gradients/margins stay
-        host-side.  With ``mesh``, each batch additionally shards over the
-        'data' axis with psum'd partials (distributed external memory).
-        gh: (N, K, 2) numpy.  Returns the (N, K) margin delta."""
+        accumulate batch-by-batch (SURVEY.md §5.7); gradients, margins
+        and deltas are O(N) and stay DEVICE-side (host round trips cost
+        seconds on tunnel-attached chips).  With ``mesh``, each batch
+        additionally shards over the 'data' axis with psum'd partials
+        (distributed external memory).
+        gh: (N, K, 2).  Returns the (N, K) margin delta (device)."""
         from xgboost_tpu.external import _paged_leaf_delta, grow_tree_paged
         from xgboost_tpu.models.updaters import parse_updaters, prune_tree
 
@@ -420,7 +422,8 @@ class GBTree:
         K = max(1, self.param.num_output_group)
         npar = max(1, self.param.num_parallel_tree)
         from xgboost_tpu.parallel import mock
-        deltas = np.zeros((dmat.num_row, K), np.float32)
+        gh = jnp.asarray(gh)
+        deltas = jnp.zeros((dmat.num_row, K), jnp.float32)
         for k in range(K):
             for t in range(npar):
                 mock.collective()
@@ -431,10 +434,10 @@ class GBTree:
                                        split_finder=self._split_finder())
                 if do_prune:
                     tree, _ = prune_tree(tree, self.param.gamma)
-                for start, batch in dmat.binned_batches():
-                    d = _paged_leaf_delta(tree, jnp.asarray(batch),
-                                          self.cfg.max_depth)
-                    deltas[start:start + batch.shape[0], k] += np.asarray(d)
+                d_k = jnp.concatenate(
+                    [_paged_leaf_delta(tree, batch, self.cfg.max_depth)
+                     for _, batch in dmat.device_batches()])
+                deltas = deltas.at[:, k].add(d_k)
                 self.trees.append(tree)
                 self.tree_group.append(k)
         self._stack_cache = None
