@@ -1,0 +1,131 @@
+"""Degenerate-input robustness (the reference guards these with
+utils::Check/Assert scattered through the core; here they must not
+crash jitted code or produce NaNs)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+P = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.5}
+
+
+def test_single_row():
+    d = xgb.DMatrix(np.array([[1.0, 2.0]], np.float32), label=[1])
+    bst = xgb.train(P, d, 2, verbose_eval=False)
+    p = np.asarray(bst.predict(d))
+    assert p.shape == (1,) and np.isfinite(p).all()
+
+
+def test_constant_feature_never_split():
+    """A feature with one distinct value has no cut candidates."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(300, 3).astype(np.float32)
+    X[:, 1] = 7.0
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(P, d, 3, verbose_eval=False)
+    used = {int(f) for t in bst.gbtree.trees
+            for f in np.asarray(t.feature) if f >= 0}
+    assert 1 not in used
+    assert np.isfinite(np.asarray(bst.predict(d))).all()
+
+
+def test_all_missing_feature():
+    X = np.full((200, 2), np.nan, np.float32)
+    X[:, 0] = np.random.RandomState(1).rand(200)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(P, d, 3, verbose_eval=False)
+    assert np.isfinite(np.asarray(bst.predict(d))).all()
+
+
+def test_uniform_labels():
+    """All-one-class data: no useful split, predictions drift toward the
+    class, no NaNs/infs."""
+    X = np.random.RandomState(2).rand(150, 4).astype(np.float32)
+    d = xgb.DMatrix(X, label=np.ones(150, np.float32))
+    bst = xgb.train(P, d, 3, verbose_eval=False)
+    p = np.asarray(bst.predict(d))
+    assert np.isfinite(p).all() and (p > 0.5).all()
+
+
+def test_max_depth_zero_is_stump_free():
+    """max_depth=0: the root itself is the only (leaf) node."""
+    X = np.random.RandomState(3).rand(100, 3).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({**P, "max_depth": 0}, d, 2, verbose_eval=False)
+    p = np.asarray(bst.predict(d))
+    assert np.isfinite(p).all()
+    # every tree is a single leaf: identical prediction for every row
+    assert np.allclose(p, p[0])
+
+
+def test_extreme_eta_and_regularization():
+    X = np.random.RandomState(4).rand(200, 3).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    for extra in ({"eta": 10.0}, {"reg_lambda": 1e6}, {"reg_alpha": 1e6},
+                  {"min_child_weight": 1e9}, {"max_delta_step": 0.01}):
+        bst = xgb.train({**P, **extra}, d, 2, verbose_eval=False)
+        assert np.isfinite(np.asarray(bst.predict(d))).all(), extra
+
+
+def test_more_bins_than_rows():
+    X = np.random.RandomState(5).rand(10, 2).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({**P, "max_bin": 256}, d, 2, verbose_eval=False)
+    assert np.isfinite(np.asarray(bst.predict(d))).all()
+
+
+def test_predict_fewer_features_than_model():
+    """A test matrix whose max feature index is below the model's
+    num_feature must still predict (absent columns = missing)."""
+    rng = np.random.RandomState(6)
+    X = rng.rand(300, 5).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    bst = xgb.train(P, xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    d_small = xgb.DMatrix((np.array([0, 1]), np.array([0]),
+                           np.array([0.7], np.float32), 2))  # CSR, 2 cols
+    p = np.asarray(bst.predict(d_small))
+    assert p.shape == (1,) and np.isfinite(p).all()
+
+
+def test_zero_weight_rows_ignored():
+    rng = np.random.RandomState(7)
+    X = rng.rand(400, 4).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    # poison half the labels but zero their weights
+    y2 = y.copy()
+    y2[200:] = 1 - y2[200:]
+    w = np.ones(400, np.float32)
+    w[200:] = 0.0
+    d_poison = xgb.DMatrix(X, label=y2, weight=w)
+    d_clean = xgb.DMatrix(X[:200], label=y[:200])
+    b1 = xgb.train(P, d_poison, 3, verbose_eval=False)
+    b2 = xgb.train(P, d_clean, 3, verbose_eval=False)
+    # zero-weight rows contribute no gradients: same error profile on
+    # the clean half
+    p1 = np.asarray(b1.predict(d_clean)) > 0.5
+    p2 = np.asarray(b2.predict(d_clean)) > 0.5
+    assert (p1 != y[:200]).mean() <= (p2 != y[:200]).mean() + 0.05
+
+
+def test_nan_label_rejected():
+    X = np.random.RandomState(8).rand(50, 2).astype(np.float32)
+    y = np.full(50, np.nan, np.float32)
+    d = xgb.DMatrix(X, label=y)
+    with pytest.raises((ValueError, AssertionError)):
+        xgb.train(P, d, 1, verbose_eval=False)
+
+
+def test_nan_label_rejected_softmax():
+    X = np.random.RandomState(9).rand(50, 2).astype(np.float32)
+    y = np.zeros(50, np.float32)
+    y[3] = np.nan
+    d = xgb.DMatrix(X, label=y)
+    with pytest.raises(ValueError):
+        xgb.train({"objective": "multi:softmax", "num_class": 3,
+                   "max_depth": 2}, d, 1, verbose_eval=False)

@@ -114,7 +114,9 @@ class RegLossObj(Objective):
         if self.loss != "linear":
             def _check():
                 lab = np.asarray(info.label)
-                if ((lab < 0) | (lab > 1)).any():
+                # negated-containment form so NaN labels fail too (the
+                # reference's CheckLabel is !(l >= 0 && l <= 1))
+                if (~((lab >= 0) & (lab <= 1))).any():
                     raise ValueError(
                         "label must be in [0,1] for logistic regression")
             info.check_once("logistic_label_ok", _check)
@@ -177,7 +179,8 @@ class SoftmaxMultiClassObj(Objective):
         assert self.nclass > 0, "must set num_class to use softmax"
         def _check():
             lab = np.asarray(info.label)
-            if ((lab < 0) | (lab >= self.nclass)).any():
+            # negated-containment form so NaN labels fail too
+            if (~((lab >= 0) & (lab < self.nclass))).any():
                 raise ValueError(
                     f"SoftmaxMultiClassObj: label must be in [0, {self.nclass})")
         info.check_once(f"softmax_label_ok_{self.nclass}", _check)
