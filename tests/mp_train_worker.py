@@ -28,16 +28,21 @@ def main():
 
     import xgboost_tpu as xgb
 
+    params = {"objective": "binary:logistic", "max_depth": 3,
+              "eta": 0.7, "max_bin": 32, "dsplit": "row"}
     dtrain = xgb.DMatrix(path)
     res = {}
-    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
-                     "eta": 0.7, "max_bin": 32, "dsplit": "row"},
-                    dtrain, 5, evals=[(dtrain, "train")],
+    bst = xgb.train(params, dtrain, 5, evals=[(dtrain, "train")],
                     evals_result=res, verbose_eval=False)
     err = float(res["train-error"][-1])
     bst.save_model(f"{out_prefix}.rank{rank}.model")
     with open(f"{out_prefix}.rank{rank}.err", "w") as f:
         f.write(f"{err:.6f}\n")
+
+    # no-evals training takes the FUSED multi-round scan across the
+    # global (cross-process) mesh; must bit-match the per-round model
+    bst_f = xgb.train(params, xgb.DMatrix(path), 5, verbose_eval=False)
+    bst_f.save_model(f"{out_prefix}.rank{rank}.fused.model")
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices("done")
 

@@ -111,8 +111,20 @@ def test_two_process_full_booster_training(tmp_path):
     err = float((tmp_path / "mp.rank0.err").read_text())
     assert err < 0.05, err
 
-    # the multi-process model predicts locally like any other model
     import xgboost_tpu as xgb
+
+    # the fused (no-evals) multi-process run produced the same model
+    b_seq = xgb.Booster(model_file=str(tmp_path / "mp.rank0.model"))
+    b_fus = xgb.Booster(
+        model_file=str(tmp_path / "mp.rank0.fused.model"))
+    s1, s2 = b_seq.gbtree.get_state(), b_fus.gbtree.get_state()
+    for k in s1:
+        np.testing.assert_array_equal(s1[k], s2[k], err_msg=k)
+    mf0 = (tmp_path / "mp.rank0.fused.model").read_bytes()
+    mf1 = (tmp_path / "mp.rank1.fused.model").read_bytes()
+    assert mf0 == mf1, "fused ranks diverged"
+
+    # the multi-process model predicts locally like any other model
     bst = xgb.Booster(model_file=str(tmp_path / "mp.rank0.model"))
     p = np.asarray(bst.predict(xgb.DMatrix(str(data))))
     assert float(np.mean((p > 0.5) != y)) < 0.05
