@@ -130,6 +130,59 @@ def test_two_process_full_booster_training(tmp_path):
     assert float(np.mean((p > 0.5) != y)) < 0.05
 
 
+def test_two_process_split_loading_bitmatches_replicated(tmp_path):
+    """VERDICT r2 #1: per-rank split loading end to end.  Each process
+    parses ONLY its row block (~N/2 host rows), assembles global device
+    arrays from process-local data, and the resulting model is
+    BYTE-IDENTICAL to a replicated-load run in the same job — both for
+    the fused scan and the per-round path with distributed (partial-sum)
+    metric evaluation."""
+    data = tmp_path / "train.libsvm"
+    rng = np.random.RandomState(11)
+    N = 801  # deliberately not divisible by the 4-device mesh
+    X = rng.rand(N, 6)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0.7).astype(int)
+    with open(data, "w") as fh:
+        for i in range(N):
+            feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(6))
+            fh.write(f"{y[i]} {feats}\n")
+
+    out = tmp_path / "sh"
+    cmd = [sys.executable, "-m", "xgboost_tpu.launch", "-n", "2",
+           "--local-devices", "2", "--",
+           sys.executable, os.path.join(REPO, "tests", "mp_shard_worker.py"),
+           str(data), str(out)]
+    r = subprocess.run(cmd, cwd=REPO, env=_clean_env(),
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+
+    # each process held only ~N/2 host rows (the scaling property)
+    tot = 0
+    for rank in range(2):
+        loc, glob = map(int, (tmp_path / f"sh.rank{rank}.rows"
+                              ).read_text().split())
+        assert glob == N
+        assert loc <= -(-N // 4) * 2, (rank, loc)  # <= 2 device shards
+        tot += loc
+    assert tot == N
+
+    for rank in range(2):
+        bitmatch, bitmatch_e, err = (
+            tmp_path / f"sh.rank{rank}.result").read_text().split()
+        assert bitmatch == "1", "split-loaded model != replicated model"
+        assert bitmatch_e == "1", "per-round model != fused model"
+        assert float(err) < 0.05, err
+
+    # ranks agree and the model is locally usable
+    m0 = (tmp_path / "sh.rank0.model").read_bytes()
+    m1 = (tmp_path / "sh.rank1.model").read_bytes()
+    assert m0 == m1
+    import xgboost_tpu as xgb
+    bst = xgb.Booster(model_file=str(tmp_path / "sh.rank0.model"))
+    p = np.asarray(bst.predict(xgb.DMatrix(str(data))))
+    assert float(np.mean((p > 0.5) != y)) < 0.05
+
+
 def test_two_process_rank_specific_death_gang_restart(tmp_path):
     """mock=rank,version,seqno,ntrial under the launcher: only the named
     rank dies, the launcher restarts the whole gang (single processes

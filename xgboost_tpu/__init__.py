@@ -18,6 +18,7 @@ from xgboost_tpu.data import DMatrix
 from xgboost_tpu.external import ExtMemDMatrix
 from xgboost_tpu.learner import (Booster, CVPack, aggcv, cv, mknfold,
                                  train)
+from xgboost_tpu.parallel.sharded import ShardedDMatrix
 from xgboost_tpu.sklearn import XGBModel, XGBClassifier, XGBRegressor
 
 __version__ = "0.1.0"
@@ -26,6 +27,7 @@ __all__ = [
     "TrainParam",
     "DMatrix",
     "ExtMemDMatrix",
+    "ShardedDMatrix",
     "Booster",
     "train",
     "cv",

@@ -52,6 +52,7 @@ class BoostLearnTask:
         self.name_dump = "dump.txt"
         self.checkpoint_dir: Optional[str] = None
         self.save_base64 = 0  # text-safe model files (reference bs64 mode)
+        self.shard_load = 1  # per-rank split loading in distributed mode
         self.mock_spec: List[Tuple[int, int, int]] = []  # fault injection
         self.keepalive = 0  # restart-on-WorkerFailure (rabit_demo keepalive)
         self.rank = 0  # process index under multi-host launch
@@ -65,6 +66,7 @@ class BoostLearnTask:
         "silent": int, "use_buffer": int, "num_round": int,
         "save_period": int, "eval_train": int, "pred_margin": int,
         "ntree_limit": int, "dump_stats": int, "save_base64": int,
+        "shard_load": int,
     }
 
     def set_param(self, name: str, val: str) -> None:
@@ -205,6 +207,44 @@ class BoostLearnTask:
         from xgboost_tpu.data import DMatrix
         return DMatrix(path, silent=self.silent != 0)
 
+    def _load_train_data(self):
+        """Training data: per-rank SPLIT loading in distributed dsplit=row
+        mode (the reference routes distributed text loads through
+        rank/npart partitioning, io.cpp:56-61 ->
+        simple_dmatrix-inl.hpp:89-96); every other case loads the full
+        matrix.  ``shard_load=0`` opts out."""
+        path = self.train_path
+        params = self._params_dict()
+        from xgboost_tpu.metrics import _DIST_METRICS
+        metrics = params.get("eval_metric", [])
+        metrics = [metrics] if isinstance(metrics, str) else list(metrics)
+        eligible = (
+            self._distributed and self.shard_load
+            and params.get("dsplit", "row") == "row"
+            and params.get("booster", "gbtree") != "gblinear"
+            and not str(params.get("objective", "")).startswith("rank:")
+            and "grow_colmaker" not in str(params.get("updater", ""))
+            # eval_train evaluates ON the training matrix: every metric
+            # needs a distributed partial-sum form there
+            and (not self.eval_train
+                 or all(m.partition("@")[0] in _DIST_METRICS
+                        for m in metrics))
+            and not path.startswith(("ext:", "!")) and "#" not in path
+            and path != "stdin" and os.path.exists(path)
+            and _looks_like_text(path))
+        if eligible:
+            try:
+                from xgboost_tpu.parallel.sharded import ShardedDMatrix
+                return ShardedDMatrix(path, silent=self.silent != 0)
+            except (NotImplementedError, ValueError) as e:
+                # ValueError: mesh shape unsuitable for block split
+                # (non-contiguous per-process devices) — replicated
+                # loading still works there
+                if self.silent < 2:
+                    print(f"[shard_load] replicated-load fallback: {e}",
+                          file=sys.stderr)
+        return self._load_data(path)
+
     def _make_booster(self, cache=()):
         from xgboost_tpu.learner import Booster
         bst = Booster(self._params_dict(), cache=list(cache))
@@ -248,7 +288,7 @@ class BoostLearnTask:
     def task_train(self) -> int:
         import xgboost_tpu  # noqa: F401  (ensure package import works early)
 
-        data = self._load_data(self.train_path)
+        data = self._load_train_data()
         evals = [(self._load_data(p), n)
                  for p, n in zip(self.eval_paths, self.eval_names)]
         if self.eval_train:
@@ -341,6 +381,17 @@ class BoostLearnTask:
             for i, s in enumerate(dumps):
                 f.write(f"booster[{i}]:\n{s}")
         return 0
+
+
+def _looks_like_text(path: str) -> bool:
+    """Cheap libsvm-text sniff: binary caches (npz/npy magics, NUL bytes)
+    route to the magic-sniffing replicated loader."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(256)
+    except OSError:
+        return False
+    return bool(head) and b"\x00" not in head and not head.startswith(b"PK")
 
 
 # -------------------------------------------------------- checkpointing

@@ -112,7 +112,35 @@ class Booster:
                 from xgboost_tpu.models.gbtree import GBTree
                 from xgboost_tpu.models.updaters import parse_updaters
                 self.num_feature = dtrain.num_col
-                if getattr(dtrain, "is_external", False):
+                if getattr(dtrain, "is_sharded", False):
+                    # per-rank split loading: no process holds full
+                    # columns, so the cut proposal MUST be the device
+                    # sketch over the global mesh (SURVEY.md §5.8)
+                    if self.param.dsplit == "col":
+                        raise NotImplementedError(
+                            "ShardedDMatrix is row-block loaded; "
+                            "dsplit=col needs feature-shard loading "
+                            "(load replicated for column split)")
+                    if "grow_colmaker" in parse_updaters(self.param.updater):
+                        raise NotImplementedError(
+                            "updater=grow_colmaker (exact greedy) needs "
+                            "cuts at every distinct value, which no "
+                            "process can propose from a row shard; load "
+                            "replicated for exact-greedy training")
+                    if getattr(self.obj, "needs_host_margin", False):
+                        raise NotImplementedError(
+                            "ranking objectives need the full margin "
+                            "and group structure on each host; load "
+                            "replicated for rank:* training")
+                    from xgboost_tpu.parallel.sketch_device import \
+                        sketch_cuts_global
+                    self._mesh = dtrain.mesh
+                    vals, w = dtrain.device_raw()
+                    cuts = sketch_cuts_global(
+                        self._mesh, vals, w, self.param.max_bin,
+                        self.param.sketch_eps, self.param.sketch_ratio)
+                    del vals, w  # transient raw floats: free before binning
+                elif getattr(dtrain, "is_external", False):
                     # streaming sketch over raw pages (SURVEY.md §5.7);
                     # paged matrices always use the histogram method, as
                     # in the reference (learner-inl.hpp:263-267)
@@ -189,7 +217,13 @@ class Booster:
                 raise ValueError(
                     f"data has {dmat.num_col} features, model was trained "
                     f"with {self.num_feature}")
-            if getattr(dmat, "is_external", False):
+            if getattr(dmat, "is_sharded", False):
+                if self.param.booster == "gblinear":
+                    raise NotImplementedError(
+                        "gblinear works on raw feature columns; per-rank "
+                        "split loading currently supports gbtree only")
+                self._cache[key] = self._make_shard_loaded_entry(dmat)
+            elif getattr(dmat, "is_external", False):
                 self._cache[key] = self._build_ext_entry(dmat)
             elif self.param.booster == "gblinear":
                 binned = self.gbtree.device_matrix(dmat)
@@ -273,6 +307,61 @@ class Booster:
         base = shard_rows(self._mesh, np.asarray(base, np.float32))
         return _CacheEntry(dmat, binned, base, info=info,
                            row_valid=row_valid, n_real=n)
+
+    def _make_shard_loaded_entry(self, dmat) -> _CacheEntry:
+        """Entry for a per-rank split-loaded matrix: every process bins
+        ONLY its local row block; the global arrays are assembled from
+        process-local data (``jax.make_array_from_process_local_data``)
+        — the reference's per-rank shard loading
+        (simple_dmatrix-inl.hpp:89-96) without any replicated host copy.
+
+        Bit-compatibility: the global (padded) row layout is identical
+        to :meth:`_make_sharded_entry`'s device placement of a
+        replicated load over the same mesh, so training produces
+        byte-identical models (tested in tests/test_launch.py)."""
+        if self._mesh is None:
+            self._mesh = dmat.mesh
+        if getattr(self.obj, "needs_host_margin", False):
+            raise NotImplementedError(
+                "ranking objectives need the full margin and group "
+                "structure on each host; load replicated for rank:*")
+        n_loc = dmat.local_num_row
+        K = self._K
+        binned_local = bin_matrix(dmat._local, self.gbtree.cuts)
+        binned = dmat.make_global(dmat.pad_local(binned_local))
+        row_valid = dmat.row_valid_global()
+
+        # the entry's info snapshot holds LOCAL host metadata (for label
+        # validation + local metric partials) and GLOBAL device arrays
+        # for the gradient kernels
+        info = MetaInfo()
+        info.label = dmat.info.label
+        info.weight = dmat.info.weight
+        info.base_margin = dmat.info.base_margin
+        if info.label is not None:
+            info._dev_cache["label"] = dmat.make_global(
+                dmat.pad_local(np.asarray(info.label, np.float32)))
+        info._dev_cache[("weight", dmat.padded_global_rows)] = \
+            dmat.make_global(dmat.pad_local(
+                np.asarray(dmat.info.get_weight(n_loc), np.float32)))
+
+        if getattr(dmat, "_full_base_margin", None) is not None:
+            # sidecar base_margin holds GLOBAL (N, K) values; slice rows
+            # here where K is known (multiclass-safe)
+            base_local = np.asarray(
+                dmat._full_base_margin, np.float32).reshape(
+                    dmat.global_num_row, K)[dmat.row_start:dmat.row_end]
+        elif dmat.info.base_margin is not None:
+            base_local = np.asarray(
+                dmat.info.base_margin, np.float32).reshape(n_loc, K)
+        else:
+            base_local = np.full(
+                (n_loc, K), self.obj.prob_to_margin(self.param.base_score),
+                np.float32)
+        base = dmat.make_global(dmat.pad_local(base_local))
+        entry = _CacheEntry(dmat, binned, base, info=info,
+                            row_valid=row_valid, n_real=dmat.global_num_row)
+        return entry
 
     def _replicated(self, x):
         """Make a device value fully addressable for host pulls: in
@@ -390,6 +479,11 @@ class Booster:
                 if prof:
                     p.block(gh)
         else:
+            if getattr(dtrain, "is_sharded", False):
+                raise NotImplementedError(
+                    "custom objectives need the full prediction/gradient "
+                    "vectors on each host; load replicated (DMatrix) for "
+                    "custom-objective training")
             # custom objective sees only the real rows; gradients are
             # zero-padded back to the device row count below in boost()
             pred = np.asarray(self._replicated(
@@ -451,6 +545,10 @@ class Booster:
     def boost(self, dtrain: DMatrix, grad, hess):
         """Boost from user-supplied gradients (reference
         XGBoosterBoostOneIter, wrapper/xgboost_wrapper.cpp:310-317)."""
+        if getattr(dtrain, "is_sharded", False):
+            raise NotImplementedError(
+                "boost() takes full gradient vectors; split-loaded "
+                "matrices have no full-vector host view")
         self._lazy_init(dtrain)
         entry = self._entry(dtrain)
         self._sync_margin(entry)
@@ -530,6 +628,33 @@ class Booster:
         """(reference BoostLearner::Predict, learner-inl.hpp:332-346 and
         Booster.predict, wrapper/xgboost.py:422-450)."""
         assert self.gbtree is not None, "model not trained/loaded"
+        if getattr(data, "is_sharded", False):
+            # split-loaded matrix: each process returns predictions for
+            # ITS OWN rows only (no host holds the full output)
+            if self.param.booster == "gblinear":
+                raise NotImplementedError(
+                    "gblinear works on raw feature columns; per-rank "
+                    "split loading currently supports gbtree only")
+            entry = self._cache.get(id(data))
+            if entry is None:
+                # transient, NOT registered (the buffer_offset=-1 path —
+                # registering every served matrix would grow the cache
+                # unboundedly)
+                entry = self._make_shard_loaded_entry(data)
+            if pred_leaf:
+                leaves = self.gbtree.predict_leaf(entry.binned, ntree_limit)
+                return data.local_block_of(leaves)[:data.local_num_row]
+            if ntree_limit == 0:
+                self._sync_margin(entry)
+                margin = entry.margin
+            else:
+                margin = self.gbtree.predict_margin(
+                    entry.binned, entry.base, ntree_limit)
+            out = data.local_block_of(self.obj.pred_transform(
+                margin, output_margin=output_margin))[:data.local_num_row]
+            if out.ndim == 2 and out.shape[1] == 1:
+                out = out[:, 0]
+            return out
         cached = self._cache.get(id(data))
         if cached is None and getattr(data, "is_external", False):
             # one-off external prediction: build a transient entry WITHOUT
@@ -603,6 +728,9 @@ class Booster:
         for dmat, name in evals:
             entry = self._entry(dmat)
             self._sync_margin(entry)
+            if getattr(dmat, "is_sharded", False):
+                self._eval_sharded(dmat, entry, name, parts, feval)
+                continue
             tr = np.asarray(self._replicated(
                 self.obj.eval_transform(entry.margin)))[:entry.n_real]
             labels = np.asarray(dmat.get_label())
@@ -623,6 +751,33 @@ class Booster:
                 mname, val = feval(preds, dmat)
                 parts.append(f"{name}-{mname}:{val:.6f}")
         return "\t".join(parts)
+
+    def _eval_sharded(self, dmat, entry, name: str, parts: List[str],
+                      feval) -> None:
+        """Distributed evaluation for a split-loaded matrix: each process
+        computes metric partials on ITS shard only, then partial sums
+        reduce across processes — the reference's rabit::Allreduce of
+        (sum, wsum) in EvalEWiseBase (evaluation-inl.hpp:45) instead of
+        the all-gather the replicated path uses."""
+        if feval is not None:
+            raise NotImplementedError(
+                "custom feval needs the full prediction vector on one "
+                "host; load the eval set replicated (DMatrix) instead")
+        local = dmat.local_block_of(self.obj.eval_transform(entry.margin))
+        preds = local[:dmat.local_num_row]
+        labels = np.asarray(dmat.info.label)
+        weights = np.asarray(dmat.info.get_weight(dmat.local_num_row))
+        for m in self._metrics():
+            if not hasattr(m, "partial_fn"):
+                from xgboost_tpu.metrics import _DIST_METRICS
+                raise NotImplementedError(
+                    f"metric {m.metric_name!r} has no distributed "
+                    "partial-sum form; supported on split-loaded data: "
+                    f"{sorted(_DIST_METRICS)}")
+            p = preds if preds.shape[1] > 1 else preds[:, 0]
+            partial = m.partial_fn(p, labels, weights, None)
+            total = dmat.allsum(partial)
+            parts.append(f"{name}-{m.metric_name}:{m.finalize_fn(total):.6f}")
 
     def eval(self, data: DMatrix, name: str = "eval", iteration: int = 0) -> str:
         return self.eval_set([(data, name)], iteration)

@@ -9,11 +9,15 @@ the trailing ``-`` convention (lists without positives score 0 instead
 of 1).
 
 Metrics run host-side in numpy (they are cheap relative to training);
-predictions arrive already eval-transformed by the objective.  In
-distributed mode predictions are global (single-controller JAX), so the
+predictions arrive already eval-transformed by the objective.  With a
+replicated load the controller sees the full prediction vector, so the
 (sum, wsum) rabit allreduce of the reference (``evaluation-inl.hpp:45``)
-is unnecessary; AUC is computed exactly rather than as the reference's
-approximate mean-of-workers (``:405-414`` — documented difference).
+is unnecessary and AUC is computed exactly.  With PER-RANK SPLIT
+loading (``parallel/sharded.py``) each process holds only its shard:
+the ``_DIST_METRICS`` table below provides per-shard partials + a
+finalize over the cross-process sum — and distributed AUC is then the
+reference's approximate mean-of-shards form (``:405-414``), NOT the
+exact global AUC (documented difference between the two modes).
 """
 
 from __future__ import annotations
@@ -245,6 +249,74 @@ def _rank_metric(preds, labels, group_ptr, n, minus, fn):
     return float(total / max(ngroup, 1))
 
 
+# ----------------------------------------------- distributed partial sums
+#
+# Per-shard (sum, wsum) partials + cross-process reduction — the
+# reference's rabit::Allreduce in EvalEWiseBase::Eval
+# (evaluation-inl.hpp:45) and EvalAuc (:405-414).  Used by the per-rank
+# split-loaded evaluation path (parallel/sharded.py) instead of
+# all-gathering predictions.
+
+def _ewise_partial(point_fn):
+    def partial(preds, labels, weights, group_ptr=None):
+        return np.array([float(np.sum(point_fn(preds, labels) * weights)),
+                         float(np.sum(weights))], np.float64)
+    return partial
+
+
+def _ratio_final(s):
+    return float(s[0] / s[1])
+
+
+def _auc_partial(preds, labels, weights, group_ptr=None):
+    """Sum of per-group AUCs + group count on this shard.  Without group
+    structure the shard is ONE group, so the reduced result is the mean
+    of per-shard AUCs — the reference's documented approximation for
+    distributed AUC (evaluation-inl.hpp:405-414), NOT the exact global
+    AUC the single-host path computes."""
+    preds = np.asarray(preds).ravel()
+    if group_ptr is None:
+        group_ptr = np.array([0, len(preds)])
+    total, ngroup = 0.0, 0
+    for g in range(len(group_ptr) - 1):
+        s, e = group_ptr[g], group_ptr[g + 1]
+        v = _auc_group(preds[s:e], labels[s:e], weights[s:e])
+        if v is None:
+            continue
+        total += v
+        ngroup += 1
+    return np.array([total, float(ngroup)], np.float64)
+
+
+def _auc_final(s):
+    if s[1] == 0:
+        raise ValueError("AUC: the dataset only contains pos or neg samples")
+    return float(s[0] / s[1])
+
+
+def _mlogloss_points(preds, labels):
+    p = np.clip(preds[np.arange(len(labels)), labels.astype(np.int64)],
+                _EPS, None)
+    return -np.log(p)
+
+
+_DIST_METRICS = {
+    "rmse": (_ewise_partial(lambda p, l: (p - l) ** 2),
+             lambda s: float(np.sqrt(s[0] / s[1]))),
+    "logloss": (_ewise_partial(lambda p, l: -(
+        l * np.log(np.clip(p, _EPS, 1 - _EPS))
+        + (1.0 - l) * np.log(1.0 - np.clip(p, _EPS, 1 - _EPS)))),
+        _ratio_final),
+    "error": (_ewise_partial(lambda p, l: np.where(
+        p > 0.5, l != 1.0, l != 0.0).astype(np.float64)), _ratio_final),
+    "merror": (_ewise_partial(lambda p, l: (
+        np.argmax(p, axis=1) != l.astype(np.int64)).astype(np.float64)),
+        _ratio_final),
+    "mlogloss": (_ewise_partial(_mlogloss_points), _ratio_final),
+    "auc": (_auc_partial, _auc_final),
+}
+
+
 # --------------------------------------------------------------- registry
 
 def create_metric(name: str) -> Callable:
@@ -268,7 +340,10 @@ def create_metric(name: str) -> Callable:
         "merror": merror, "mlogloss": mlogloss, "auc": auc,
     }
     if not at and base in simple:
-        return _named(simple[base], name)
+        fn = _named(simple[base], name)
+        if base in _DIST_METRICS:
+            fn.partial_fn, fn.finalize_fn = _DIST_METRICS[base]
+        return fn
     if base == "ams":
         ratio = float(suffix) if suffix else 0.15
         return _named(lambda p, l, w, g=None: ams(p, l, w, g, ratio), name)

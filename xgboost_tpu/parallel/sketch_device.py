@@ -228,6 +228,40 @@ def _sketch_shard(values, weights, K: int, max_bin: int, axis_name: str):
     return cuts, acc
 
 
+def sketch_cuts_global(mesh, values_dev, weights_dev,
+                       max_bin: int = 256, sketch_eps: float = 0.03,
+                       sketch_ratio: float = 2.0):
+    """Propose cuts from GLOBAL device arrays already row-sharded over
+    ``mesh``'s 'data' axis.
+
+    This is the true multi-host entry point: with per-rank split loading
+    (:class:`xgboost_tpu.parallel.sharded.ShardedDMatrix`) each process
+    contributed only its local rows to ``values_dev``, so no host ever
+    materializes a full feature column — the cut proposal happens
+    entirely in the mesh (local summaries -> all_gather -> associative
+    fold), exactly the SerializeReducer role (quantile.h:587-593).
+
+    values_dev: (N_pad, F) float32, NaN = missing;
+    weights_dev: (N_pad,) float32, 0 on padding rows.
+    Returns a host CutMatrix (identical on every process — the fold is
+    deterministic and the output is replicated).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from xgboost_tpu.binning import pack_cuts
+
+    K = max(8, int(sketch_ratio / max(sketch_eps, 1.0 / max_bin)))
+    fn = jax.shard_map(
+        functools.partial(_sketch_shard, K=K, max_bin=max_bin,
+                          axis_name="data"),
+        mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=(P(), P()), check_vma=False)
+    cuts_padded, _ = jax.jit(fn)(values_dev, weights_dev)
+    cuts_np = np.asarray(cuts_padded)  # replicated -> host pull is local
+    per_feature = [c[np.isfinite(c)].astype(np.float32) for c in cuts_np]
+    return pack_cuts(per_feature)
+
+
 def sketch_cuts_mesh(mesh, values: np.ndarray, weights: np.ndarray | None,
                      max_bin: int = 256, sketch_eps: float = 0.03,
                      sketch_ratio: float = 2.0):
@@ -238,37 +272,30 @@ def sketch_cuts_mesh(mesh, values: np.ndarray, weights: np.ndarray | None,
     Returns a host :class:`xgboost_tpu.binning.CutMatrix` (identical on
     every shard — the fold is deterministic).
 
-    Single-controller note: ``values`` here is the full dense matrix the
-    controller already holds (the per-shard split happens at device-put).
-    A true multi-host deployment calls :func:`_sketch_shard` under its own
-    pjit with each process contributing only its local rows — the merge
-    semantics are the same; no host ever aggregates raw columns.
+    Single-controller convenience wrapper: ``values`` here is the full
+    dense matrix the controller already holds (the per-shard split
+    happens at device-put).  Per-rank split loading goes through
+    :func:`sketch_cuts_global` with each process contributing only its
+    local rows — same merge, bit-identical cuts.
     """
-    from jax.sharding import PartitionSpec as P
-
-    from xgboost_tpu.binning import pack_cuts
-
-    K = max(8, int(sketch_ratio / max(sketch_eps, 1.0 / max_bin)))
     n_shard = mesh.devices.size
     N, F = values.shape
     pad = (-N) % n_shard
+    # missing/padding marker is +inf, NOT NaN: the sketch treats any
+    # non-finite as missing, and in multi-process mode the runtime
+    # asserts replicated device_put inputs are value-equal across
+    # processes — which NaN can never be (NaN != NaN)
+    if np.isnan(values).any():  # avoid a full-matrix copy when dense
+        values = np.where(np.isnan(values), np.inf, values)
     if pad:
         values = np.concatenate(
-            [values, np.full((pad, F), np.nan, values.dtype)])
+            [values, np.full((pad, F), np.inf, values.dtype)])
         w = np.ones(N + pad, np.float32)
         w[N:] = 0.0
     else:
         w = np.ones(N, np.float32)
     if weights is not None:
         w[:N] = weights
-
-    fn = jax.shard_map(
-        functools.partial(_sketch_shard, K=K, max_bin=max_bin,
-                          axis_name="data"),
-        mesh=mesh, in_specs=(P("data"), P("data")),
-        out_specs=(P(), P()), check_vma=False)
-    cuts_padded, _ = jax.jit(fn)(jnp.asarray(values, jnp.float32),
-                                 jnp.asarray(w))
-    cuts_np = np.asarray(cuts_padded)
-    per_feature = [c[np.isfinite(c)].astype(np.float32) for c in cuts_np]
-    return pack_cuts(per_feature)
+    return sketch_cuts_global(
+        mesh, jnp.asarray(values, jnp.float32), jnp.asarray(w),
+        max_bin, sketch_eps, sketch_ratio)
