@@ -286,3 +286,34 @@ def test_sharded_dmatrix_single_process_bitmatch(mesh8, tmp_path):
                   xgb.ShardedDMatrix(str(path)), 1, verbose_eval=False)
     with pytest.raises(NotImplementedError):
         dm_s.slice(np.arange(4))
+
+
+def test_dp_gblinear_matches_single_device(mesh8):
+    """Distributed gblinear (VERDICT r2 item 10): rows sharded over the
+    mesh, Gf/Hf reductions psum'd — matches single-device coordinate
+    descent to float tolerance, padding rows inert."""
+    rng = np.random.RandomState(3)
+    n = 1021  # not divisible by 8: exercises zero-padding rows
+    X = rng.rand(n, 6).astype(np.float32)
+    w_true = np.array([1.5, -2.0, 0.0, 0.7, 0.0, 0.3], np.float32)
+    y = (X @ w_true + 0.1 * rng.randn(n) > 0.5).astype(np.float32)
+    params = {"booster": "gblinear", "objective": "binary:logistic",
+              "eta": 0.5, "lambda": 0.1, "alpha": 0.05}
+
+    d1 = xgb.DMatrix(X, label=y)
+    bst1 = xgb.train(params, d1, 8, verbose_eval=False)
+    p1 = bst1.predict(d1)
+
+    d2 = xgb.DMatrix(X, label=y)
+    res = {}
+    bst2 = xgb.train({**params, "dsplit": "row"}, d2, 8,
+                     evals=[(d2, "train")], evals_result=res,
+                     verbose_eval=False)
+    p2 = bst2.predict(d2)
+
+    assert p2.shape == (n,)
+    np.testing.assert_allclose(p1, p2, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(bst1.gbtree.weight),
+                               np.asarray(bst2.gbtree.weight),
+                               rtol=2e-4, atol=2e-5)
+    assert res["train-error"][-1] < 0.2

@@ -1,0 +1,66 @@
+"""Cluster submitters (parallel/submit.py) — the rabit_mpi/sge/yarn
+submitter analog.  No scheduler exists in CI, so the tests assert the
+constructed commands/scripts (dry-run) and the env contract round-trip
+(scheduler vars -> rank/world)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from xgboost_tpu.parallel.launch import COORD_ENV, NWORKER_ENV, RANK_ENV
+from xgboost_tpu.parallel.submit import (mpi_command, scheduler_rank,
+                                         sge_script, slurm_command, submit)
+
+
+def test_mpi_command_exports_contract():
+    line = mpi_command(4, "h0:9", ["python", "w.py"])
+    assert line[:3] == ["mpirun", "-n", "4"]
+    assert f"{COORD_ENV}=h0:9" in line and f"{NWORKER_ENV}=4" in line
+    assert line[-2:] == ["python", "w.py"]
+
+
+def test_sge_script_maps_task_id_to_rank():
+    s = sge_script(3, "h0:9", ["python", "w.py", "a b"])
+    assert "#$ -t 1-3" in s
+    assert f"export {COORD_ENV}=h0:9" in s
+    assert f"export {RANK_ENV}=$((SGE_TASK_ID-1))" in s
+    assert "exec python w.py 'a b'" in s
+
+
+def test_slurm_command():
+    line = slurm_command(2, "h0:9", ["w"])
+    assert line[0] == "srun" and "--ntasks=2" in line
+
+
+def test_scheduler_rank_resolution(monkeypatch):
+    for v in ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+              "SLURM_PROCID", "SLURM_NTASKS", "SGE_TASK_ID",
+              "SGE_TASK_LAST", "PMI_RANK", "PMI_SIZE"):
+        monkeypatch.delenv(v, raising=False)
+    assert scheduler_rank() is None
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")
+    assert scheduler_rank() == (2, 8)
+    monkeypatch.delenv("OMPI_COMM_WORLD_RANK")
+    monkeypatch.delenv("OMPI_COMM_WORLD_SIZE")
+    monkeypatch.setenv("SGE_TASK_ID", "1")
+    monkeypatch.setenv("SGE_TASK_LAST", "4")
+    assert scheduler_rank() == (0, 4)
+
+
+def test_submit_dry_run_cli():
+    r = subprocess.run(
+        [sys.executable, "-m", "xgboost_tpu.parallel.submit", "-n", "2",
+         "--mode", "sge", "--coord", "h:1", "--dry-run", "--",
+         "python", "w.py"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr
+    assert "#$ -t 1-2" in r.stdout
+
+
+def test_submit_needs_coord_for_schedulers():
+    with pytest.raises(ValueError, match="--coord"):
+        submit(2, ["w"], mode="sge", dry_run=True)

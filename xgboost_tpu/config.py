@@ -74,8 +74,17 @@ class TrainParam:
     max_bin: int = 256
     # dsplit=row cut proposal on device: per-shard sketches merged over the
     # mesh axis (parallel/sketch_device.py — rabit SerializeReducer analog,
-    # histmaker-inl.hpp:417-424).  0 = host-side global sketch.
-    device_sketch: int = 0
+    # histmaker-inl.hpp:417-424).  0 = host-side global sketch; -1 = auto:
+    # device sketch whenever the job is MULTI-PROCESS (the distributed
+    # default — no host should aggregate full columns), host sketch in
+    # single-controller mode (keeps single-device bit-equality).
+    # Split-loaded matrices (parallel/sharded.py) always device-sketch.
+    device_sketch: int = -1
+    # histogram accumulation precision (recorded in saved models):
+    # "auto" = bf16 MXU kernel on TPU / exact scatter elsewhere;
+    # "fp32" forces exact-f32 histograms; "bf16" forces the MXU pass.
+    # XGBTPU_HIST remains an env override (test seam).
+    hist_precision: str = "auto"
     # gblinear coordinate-descent block size: 1 = exact sequential CD
     # (convergent under feature correlation); >1 = shotgun-style parallel
     # updates within each block (reference gblinear-inl.hpp:76-105)
@@ -83,6 +92,11 @@ class TrainParam:
 
     # -- gbtree params (reference src/gbm/gbtree-inl.hpp:389-428) --
     num_parallel_tree: int = 1
+    # multi-root trees (reference TreeParam::num_roots, tree/param.h):
+    # rows enter the tree at per-row roots given by the root_index meta
+    # field (data.h:39-58); trees reserve ceil(log2 num_roots) top levels
+    # as root slots
+    num_roots: int = 1
     updater: str = "grow_histmaker,prune"
     # exact-greedy (grow_colmaker) cap on distinct values per feature
     max_exact_bin: int = 4096

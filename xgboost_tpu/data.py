@@ -88,7 +88,8 @@ class MetaInfo:
         elif name in ("label", "weight", "base_margin"):
             setattr(self, name, arr.astype(np.float32).ravel())
         elif name in ("root_index", "fold_index"):
-            setattr(self, name, arr.astype(np.int32).ravel())
+            # uint32: full reference XGDMatrixSetUIntInfo range
+            setattr(self, name, arr.astype(np.uint32).ravel())
         else:
             raise ValueError(f"unknown meta field {name!r}")
 
@@ -116,12 +117,16 @@ class DMatrix:
     """
 
     def __new__(cls, data: Any = None, *args, **kwargs):
-        # "ext:path" / "!path" URIs construct the paged matrix (reference
-        # io.cpp routes paged magics and the '!' HalfRAM prefix the same
-        # way, io.cpp:36-81); ExtMemDMatrix is not a subclass, so
-        # __init__ below is skipped for it.
+        # "ext:path" / "!path#cache" URIs construct the paged matrix
+        # (reference io.cpp routes paged magics and the '!' HalfRAM
+        # prefix the same way, io.cpp:36-81); ExtMemDMatrix is not a
+        # subclass, so __init__ below is skipped for it.  The '!' prefix
+        # is only honored TOGETHER with a '#cache' suffix, matching the
+        # reference's routing (io.cpp:70-73 checks '!' inside the
+        # cache-file branch only; a bare '!file' is a plain file load).
         if cls is DMatrix and isinstance(data, str) and (
-                data.startswith("ext:") or data.startswith("!")):
+                data.startswith("ext:")
+                or (data.startswith("!") and "#" in data)):
             from xgboost_tpu.external import ExtMemDMatrix
             path = data[4:] if data.startswith("ext:") else data
             names = ("label", "weight", "missing", "base_margin", "group",
@@ -245,10 +250,10 @@ class DMatrix:
         arr = np.asarray(data)
         if arr.size and (not np.issubdtype(arr.dtype, np.integer)
                          or int(arr.min()) < 0
-                         or int(arr.max()) > np.iinfo(np.int32).max):
+                         or int(arr.max()) > np.iinfo(np.uint32).max):
             raise ValueError(
-                f"set_uint_info({field!r}): values must be non-negative "
-                "integers < 2**31 (stored as int32)")
+                f"set_uint_info({field!r}): values must fit uint32 "
+                "(reference XGDMatrixSetUIntInfo range)")
         self.info.set_field(field, arr)
 
     def get_uint_info(self, field: str) -> np.ndarray:

@@ -77,7 +77,13 @@ def dump_trees(booster, fmap: str = "", with_stats: bool = False) -> List[str]:
             rec(left, depth + 1)
             rec(right, depth + 1)
 
-        rec(0, 0)
+        # multi-root trees dump each root's subtree (the reference dumps
+        # every root, model.h:403-458 over param.num_roots)
+        from xgboost_tpu.models.tree import root_level
+        n_roots = max(1, getattr(booster.param, "num_roots", 1))
+        first = (1 << root_level(n_roots)) - 1
+        for r in range(n_roots):
+            rec(first + r, 0)
         out.append("\n".join(lines) + "\n")
     return out
 

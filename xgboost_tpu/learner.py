@@ -47,6 +47,7 @@ class _CacheEntry:
         self.margin: Optional[jax.Array] = None
         self.applied = 0                 # trees folded into margin
         self.external = external         # paged matrix: margin lives on host
+        self.root: Optional[jax.Array] = None  # per-row root slots (N_pad,)
         self.info_version = dmat.info.version  # source-snapshot tracking
 
 
@@ -154,7 +155,10 @@ class Booster:
                     from xgboost_tpu.binning import compute_cuts_exact
                     cuts = compute_cuts_exact(dtrain,
                                               self.param.max_exact_bin)
-                elif self.param.device_sketch and self.param.dsplit == "row":
+                elif self.param.dsplit == "row" and (
+                        self.param.device_sketch > 0
+                        or (self.param.device_sketch < 0
+                            and jax.process_count() > 1)):
                     # distributed cut proposal: per-shard device sketches
                     # merged over the mesh axis — no host needs a full
                     # column (SerializeReducer analog, SURVEY.md §5.8)
@@ -173,6 +177,16 @@ class Booster:
                                         self.param.sketch_eps,
                                         self.param.sketch_ratio)
                 self.gbtree = GBTree(self.param, cuts)
+        if getattr(dtrain, "is_sharded", False) and self._mesh is None:
+            # continued training (loaded model) on a split-loaded matrix:
+            # mesh resolution belongs HERE, not in the entry builder
+            self._mesh = dtrain.mesh
+        if self.param.booster == "gblinear":
+            # distributed gblinear (dsplit=row): rows shard over the mesh,
+            # Gf/Hf reductions psum (VERDICT r2 item 10)
+            from xgboost_tpu.parallel import mesh as pmesh
+            if self.param.dsplit == "row" and self._mesh is None:
+                self._mesh = pmesh.get_mesh() or pmesh.data_parallel_mesh()
         if self.param.booster != "gblinear":
             from xgboost_tpu.parallel import mesh as pmesh
             if self.param.dsplit == "row" and self._mesh is None:
@@ -226,9 +240,15 @@ class Booster:
             elif getattr(dmat, "is_external", False):
                 self._cache[key] = self._build_ext_entry(dmat)
             elif self.param.booster == "gblinear":
-                binned = self.gbtree.device_matrix(dmat)
-                self._cache[key] = _CacheEntry(
-                    dmat, binned, self._base_margin_of(dmat, dmat.num_row))
+                if self._mesh is not None:
+                    # dsplit=row: rows shard over the mesh (the dense X
+                    # plays the role binned ids play for gbtree)
+                    self._cache[key] = self._make_sharded_entry(
+                        dmat, binned_np=self.gbtree.host_matrix(dmat))
+                else:
+                    binned = self.gbtree.device_matrix(dmat)
+                    self._cache[key] = _CacheEntry(
+                        dmat, binned, self._base_margin_of(dmat, dmat.num_row))
             elif self._mesh is not None:
                 self._cache[key] = self._make_sharded_entry(dmat)
             else:
@@ -241,7 +261,26 @@ class Booster:
                         binned, self._col_mesh.devices.size, axis=1)
                 self._cache[key] = _CacheEntry(
                     dmat, binned, self._base_margin_of(dmat, dmat.num_row))
+            self._attach_root(self._cache[key], dmat)
         return self._cache[key]
+
+    def _attach_root(self, entry: _CacheEntry, dmat) -> None:
+        """Per-row root slots (multi-root trees, reference root_index
+        data.h:39-58), padded to the entry's device row count."""
+        ri = getattr(dmat.info, "root_index", None)
+        if ri is None or max(1, self.param.num_roots) <= 1:
+            return
+        if entry.external:
+            raise NotImplementedError(
+                "root_index on external-memory matrices is not supported")
+        n_dev = entry.binned.shape[0]
+        r = np.zeros(n_dev, np.int32)
+        r[:len(ri)] = np.asarray(ri, np.int64).astype(np.int32)
+        if self._mesh is not None and not getattr(dmat, "is_sharded", False):
+            from xgboost_tpu.parallel.dp import shard_rows
+            entry.root = shard_rows(self._mesh, r)
+        else:
+            entry.root = jnp.asarray(r)
 
     def _build_ext_entry(self, dmat) -> _CacheEntry:
         """Entry for an external-memory matrix (not necessarily cached)."""
@@ -319,8 +358,6 @@ class Booster:
         to :meth:`_make_sharded_entry`'s device placement of a
         replicated load over the same mesh, so training produces
         byte-identical models (tested in tests/test_launch.py)."""
-        if self._mesh is None:
-            self._mesh = dmat.mesh
         if getattr(self.obj, "needs_host_margin", False):
             raise NotImplementedError(
                 "ranking objectives need the full margin and group "
@@ -397,7 +434,8 @@ class Booster:
             chunk = self.gbtree.trees[entry.applied:entry.applied + per_round]
             first_group = self.gbtree.tree_group[entry.applied]
             entry.margin = self.gbtree.predict_incremental(
-                entry.binned, entry.margin, chunk, first_group)
+                entry.binned, entry.margin, chunk, first_group,
+                root=entry.root)
             entry.applied += len(chunk)
 
     def _sync_margin_ext(self, entry: _CacheEntry):
@@ -527,6 +565,7 @@ class Booster:
             and not os.environ.get("XGBTPU_SEQ_BOOST")
             and self.profiler is None
             and not (self.param.gamma > 0.0 and "prune" in ups)
+            and max(1, self.param.num_roots) == 1
             and "refresh" not in ups
             and any(u.startswith("grow") for u in ups)
             and self.obj.fused_grad() is not None)
@@ -577,7 +616,8 @@ class Booster:
         key = jax.random.fold_in(
             jax.random.PRNGKey(self.param.seed), iteration)
         if self.param.booster == "gblinear":
-            self.gbtree.do_boost(entry.binned, gh, dtrain.info)
+            self.gbtree.do_boost(entry.binned, gh, dtrain.info,
+                                 mesh=self._mesh)
             entry.applied = self.gbtree.version  # recompute on next sync
             entry.margin = None
             self._sync_margin(entry)
@@ -599,7 +639,8 @@ class Booster:
             _, delta = self.gbtree.do_boost(entry.binned, gh, key,
                                             row_valid=entry.row_valid,
                                             mesh=self._mesh,
-                                            col_mesh=self._col_mesh)
+                                            col_mesh=self._col_mesh,
+                                            root=entry.root)
             entry.margin = entry.margin + delta
             entry.applied = self.gbtree.num_trees
         if "refresh" in ups:
@@ -609,12 +650,13 @@ class Booster:
             # gradient snapshot, like the reference's sequential updaters.
             self.gbtree.do_refresh(entry.binned, gh,
                                    row_valid=entry.row_valid,
-                                   mesh=self._mesh)
+                                   mesh=self._mesh, root=entry.root)
             if "prune" in ups and self.param.gamma > 0.0 and not grows:
                 # "refresh,prune": prune against the refreshed gains
                 from xgboost_tpu.models.updaters import prune_tree
                 for i, t in enumerate(self.gbtree.trees):
-                    self.gbtree.trees[i], _ = prune_tree(t, self.param.gamma)
+                    self.gbtree.trees[i], _ = prune_tree(
+                        t, self.param.gamma, self.gbtree.cfg.n_roots)
                 self.gbtree._stack_cache = None
             # leaf values changed: every cached margin is stale
             for e in self._cache.values():
@@ -696,15 +738,24 @@ class Booster:
             base = self._base_margin_of(data, data.num_row)
         else:
             binned, base = cached.binned, cached.base
+        if cached is not None:
+            root = cached.root
+        elif (getattr(data.info, "root_index", None) is not None
+                and max(1, self.param.num_roots) > 1):
+            root = jnp.asarray(
+                np.asarray(data.info.root_index, np.int64), jnp.int32)
+        else:
+            root = None
         if pred_leaf:
             leaves = np.asarray(self._replicated(
-                self.gbtree.predict_leaf(binned, ntree_limit)))
+                self.gbtree.predict_leaf(binned, ntree_limit, root=root)))
             return leaves[:cached.n_real] if cached is not None else leaves
         if cached is not None and ntree_limit == 0:
             self._sync_margin(cached)
             margin = cached.margin
         else:
-            margin = self.gbtree.predict_margin(binned, base, ntree_limit)
+            margin = self.gbtree.predict_margin(binned, base, ntree_limit,
+                                                root=root)
         out = self.obj.pred_transform(margin, output_margin=output_margin)
         out = np.asarray(self._replicated(out))
         if cached is not None:

@@ -34,16 +34,24 @@ from xgboost_tpu.data import DMatrix
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "eta", "lam", "alpha", "lam_bias", "block"))
+    "eta", "lam", "alpha", "lam_bias", "block", "axis_name"))
 def _linear_boost_step(X, gh, weight, bias, eta, lam, alpha, lam_bias,
-                       block=1):
+                       block=1, axis_name=None):
     """One round of bias + block-sequential coordinate updates.
 
     X: (N, F) dense (0 = missing); gh: (N, K, 2); weight: (F, K); bias: (K,).
+    With ``axis_name`` (dsplit=row: rows sharded over a mesh axis), every
+    row reduction — the bias sums and each block's ``Gf``/``Hf`` — psums
+    over the axis, exactly where the reference would allreduce
+    (gblinear-inl.hpp:45-106 runs on the local shard; the distributed
+    completion is VERDICT r2 item 10).  The residual update stays
+    shard-local (rows only see their own delta effect).
     """
+    red = (lambda x: jax.lax.psum(x, axis_name)) if axis_name else \
+        (lambda x: x)
     g, h = gh[..., 0], gh[..., 1]            # (N, K)
     # bias step (CalcDeltaBias)
-    sum_g, sum_h = g.sum(axis=0), h.sum(axis=0)
+    sum_g, sum_h = red(g.sum(axis=0)), red(h.sum(axis=0))
     dbias = eta * (-(sum_g + lam_bias * bias) / (sum_h + lam_bias + 1e-12))
     bias = bias + dbias
     g = g + h * dbias[None, :]               # remove bias effect (ref :66-73)
@@ -60,8 +68,8 @@ def _linear_boost_step(X, gh, weight, bias, eta, lam, alpha, lam_bias,
         g, weight = carry
         Xb = jax.lax.dynamic_slice_in_dim(X, b * bf, bf, 1)       # (N, bf)
         wb = jax.lax.dynamic_slice_in_dim(weight, b * bf, bf, 0)  # (bf, K)
-        Gf = Xb.T @ g                        # (bf, K)
-        Hf = (Xb * Xb).T @ h
+        Gf = red(Xb.T @ g)                   # (bf, K)
+        Hf = red((Xb * Xb).T @ h)
         # CalcDelta elastic-net step (ref :213-225)
         tmp = wb - (Gf + lam * wb) / (Hf + lam)
         pos = -(Gf + lam * wb + alpha) / (Hf + lam)
@@ -78,6 +86,30 @@ def _linear_boost_step(X, gh, weight, bias, eta, lam, alpha, lam_bias,
     (g, weight), _ = jax.lax.scan(body, (g, weight),
                                   jnp.arange(n_blocks))
     return weight[:F], bias
+
+
+@functools.lru_cache(maxsize=None)
+def _linear_boost_step_dp_fn(mesh, eta, lam, alpha, lam_bias, block):
+    """Compiled row-sharded boosting step, cached per (mesh, params) so
+    per-round calls hit the jit cache instead of re-tracing (meshes are
+    hashable; floats come in already-coerced)."""
+    from jax.sharding import PartitionSpec as P
+    fn = jax.shard_map(
+        functools.partial(
+            _linear_boost_step.__wrapped__, eta=eta, lam=lam, alpha=alpha,
+            lam_bias=lam_bias, block=block, axis_name="data"),
+        mesh=mesh, in_specs=(P("data"), P("data"), P(), P()),
+        out_specs=(P(), P()), check_vma=False)
+    return jax.jit(fn)
+
+
+def _linear_boost_step_dp(mesh, X, gh, weight, bias, eta, lam, alpha,
+                          lam_bias, block=1):
+    """Row-sharded boosting round: X/gh sharded over 'data', weight/bias
+    replicated; reductions psum over the mesh (bit-matches single-device
+    up to reduction order)."""
+    return _linear_boost_step_dp_fn(mesh, eta, lam, alpha, lam_bias,
+                                    block)(X, gh, weight, bias)
 
 
 @jax.jit
@@ -100,27 +132,40 @@ class GBLinear:
     def num_boosted_rounds(self) -> int:
         return self.version
 
-    def device_matrix(self, dmat: DMatrix) -> jax.Array:
-        """Dense (N, F) device matrix, 0 for missing entries."""
+    def host_matrix(self, dmat: DMatrix) -> np.ndarray:
+        """Dense (N, F) host matrix, 0 for missing entries."""
         X = dmat.to_dense(missing=np.nan)
         if X.shape[1] < self.num_feature:
             X = np.pad(X, ((0, 0), (0, self.num_feature - X.shape[1])),
                        constant_values=np.nan)
-        return jnp.asarray(np.nan_to_num(X[:, :self.num_feature], nan=0.0))
+        return np.nan_to_num(X[:, :self.num_feature], nan=0.0)
 
-    def do_boost(self, X: jax.Array, gh: jax.Array, info=None) -> None:
-        self.weight, self.bias = _linear_boost_step(
-            X, gh, self.weight, self.bias,
-            float(self.param.eta), float(self.param.reg_lambda),
-            float(self.param.reg_alpha), float(self.param.lambda_bias),
-            block=max(1, self.param.linear_block))
+    def device_matrix(self, dmat: DMatrix) -> jax.Array:
+        return jnp.asarray(self.host_matrix(dmat))
+
+    def do_boost(self, X: jax.Array, gh: jax.Array, info=None,
+                 mesh=None) -> None:
+        if mesh is not None:
+            self.weight, self.bias = _linear_boost_step_dp(
+                mesh, X, gh, self.weight, self.bias,
+                float(self.param.eta), float(self.param.reg_lambda),
+                float(self.param.reg_alpha), float(self.param.lambda_bias),
+                block=max(1, self.param.linear_block))
+        else:
+            self.weight, self.bias = _linear_boost_step(
+                X, gh, self.weight, self.bias,
+                float(self.param.eta), float(self.param.reg_lambda),
+                float(self.param.reg_alpha), float(self.param.lambda_bias),
+                block=max(1, self.param.linear_block))
         self.version += 1
 
-    def predict_margin(self, X: jax.Array, base, ntree_limit: int = 0):
+    def predict_margin(self, X: jax.Array, base, ntree_limit: int = 0,
+                       root=None):
+        # root (multi-root trees) has no meaning for a linear model
         return _linear_predict(X, self.weight, self.bias,
                                jnp.asarray(base, jnp.float32))
 
-    def predict_leaf(self, X, ntree_limit: int = 0):
+    def predict_leaf(self, X, ntree_limit: int = 0, root=None):
         raise ValueError("pred_leaf is not defined for the gblinear booster")
 
     # ------------------------------------------------------------ serialize

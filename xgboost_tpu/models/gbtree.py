@@ -35,7 +35,9 @@ def make_grow_config(p: TrainParam, n_bin: int) -> GrowConfig:
     return GrowConfig(split=split, max_depth=p.max_depth, n_bin=n_bin,
                       subsample=p.subsample,
                       colsample_bytree=p.colsample_bytree,
-                      colsample_bylevel=p.colsample_bylevel)
+                      colsample_bylevel=p.colsample_bylevel,
+                      hist_precision=p.hist_precision,
+                      n_roots=max(1, p.num_roots))
 
 
 @functools.partial(jax.jit, static_argnames=("t",))
@@ -127,12 +129,27 @@ class GBTree:
         self.cuts = cuts
         self.cfg = make_grow_config(param, cuts.max_bin)
         self._split_finder_cache = None  # stable identity (jit static arg)
-        self.trees: List[TreeArrays] = []      # device pytrees, one per tree
+        self._trees_list: List[TreeArrays] = []  # materialized per-tree pytrees
+        # stacked trees not yet sliced into _trees_list (fused rounds /
+        # model load keep the ensemble stacked; slicing T trees eagerly
+        # costs a T-output jit per distinct T and duplicates the stack)
+        self._pending: Optional[Tuple[TreeArrays, int]] = None
         self.tree_group: List[int] = []
         self._stack_cache: Optional[Tuple[int, TreeArrays, jax.Array]] = None
         self.cut_values_dev = jnp.asarray(cuts.cut_values)
         self.n_cuts_dev = jnp.asarray(cuts.n_cuts)
         self._col_pad_cache = None  # (n_shard, cut_values, n_cuts)
+
+    @property
+    def trees(self) -> List[TreeArrays]:
+        """Per-tree pytree list; materializes any stacked pending trees
+        on first access (prediction/save after fused training go through
+        the stack cache and never pay this)."""
+        if self._pending is not None:
+            flat, t = self._pending
+            self._pending = None
+            self._trees_list.extend(_unstack_trees(flat, t))
+        return self._trees_list
 
     def col_arrays(self, n_shard: int):
         """Cut arrays feature-padded to the column mesh (cached: padding
@@ -164,18 +181,21 @@ class GBTree:
 
     @property
     def num_trees(self) -> int:
-        return len(self.trees)
+        return len(self._trees_list) + (
+            self._pending[1] if self._pending is not None else 0)
 
     @property
     def num_boosted_rounds(self) -> int:
         k = max(1, self.param.num_output_group) * max(
             1, self.param.num_parallel_tree)
-        return len(self.trees) // k
+        return self.num_trees // k
 
     # ---------------------------------------------------------------- boost
     def do_boost(self, binned: jax.Array, gh: jax.Array, key: jax.Array,
                  row_valid: Optional[jax.Array] = None,
-                 mesh=None, col_mesh=None) -> Tuple[List[TreeArrays], jax.Array]:
+                 mesh=None, col_mesh=None,
+                 root: Optional[jax.Array] = None
+                 ) -> Tuple[List[TreeArrays], jax.Array]:
         """One boosting round: grows num_output_group × num_parallel_tree
         trees (reference BoostNewTrees, gbtree-inl.hpp:238-273), then runs
         the prune updater if configured (reference updater pipeline
@@ -206,10 +226,15 @@ class GBTree:
         # small-table lookups batch as broadcast-compare selects instead
         # of ~12 ms kCustom gathers (tree.table_lookup; PROFILE.md).
         # XGBTPU_SEQ_BOOST=1 restores sequential launches.
+        if root is not None and (col_mesh is not None
+                                 or self.cfg.n_roots <= 1):
+            raise NotImplementedError(
+                "root_index needs num_roots > 1 (and dsplit != col): set "
+                "num_roots to the number of tree roots")
         if (col_mesh is None and K * npar > 1
                 and not os.environ.get("XGBTPU_SEQ_BOOST")):
             return self._do_boost_vmapped(binned, gh, key, row_valid, mesh,
-                                          K, npar, do_prune)
+                                          K, npar, do_prune, root)
         for k in range(K):
             delta_k = None
             for t in range(npar):
@@ -240,15 +265,16 @@ class GBTree:
                     tree, row_leaf, d = grow_tree_dp(
                         mesh, tkey, binned, gh[:, k, :], self.cut_values_dev,
                         self.n_cuts_dev, self.cfg, rv,
-                        split_finder=self._split_finder())
+                        split_finder=self._split_finder(), root=root)
                 else:
                     tree, row_leaf = grow_tree(
                         tkey, binned, gh[:, k, :], self.cut_values_dev,
                         self.n_cuts_dev, self.cfg, row_valid,
-                        split_finder=self._split_finder())
+                        split_finder=self._split_finder(), root=root)
                     d = None
                 if do_prune:
-                    tree, resolve = prune_tree(tree, self.param.gamma)
+                    tree, resolve = prune_tree(tree, self.param.gamma,
+                                               self.cfg.n_roots)
                     d = tree.leaf_value[jnp.asarray(resolve)[row_leaf]]
                 elif d is None:
                     d = tree.leaf_value[row_leaf]
@@ -266,7 +292,7 @@ class GBTree:
         return new_trees, jnp.stack(deltas, axis=1)
 
     def _do_boost_vmapped(self, binned, gh, key, row_valid, mesh,
-                          K: int, npar: int, do_prune: bool):
+                          K: int, npar: int, do_prune: bool, root=None):
         """Grow the round's K*npar trees in a single vmapped launch
         (reference: one tree per class group per round,
         gbtree-inl.hpp:104-117, num_parallel_tree :247-253 — here the
@@ -298,13 +324,15 @@ class GBTree:
                 return grow_tree_dp(mesh, tkey, binned, gh2,
                                     self.cut_values_dev, self.n_cuts_dev,
                                     self.cfg, rv,
-                                    split_finder=self._split_finder())
+                                    split_finder=self._split_finder(),
+                                    root=root)
             stacked, row_leafs, ds = jax.vmap(one)(keys, gh_t)
         else:
             def one(tkey, gh2):
                 return grow_tree(tkey, binned, gh2, self.cut_values_dev,
                                  self.n_cuts_dev, self.cfg, row_valid,
-                                 split_finder=self._split_finder())
+                                 split_finder=self._split_finder(),
+                                 root=root)
             stacked, row_leafs = jax.vmap(one)(keys, gh_t)
             ds = None
 
@@ -314,7 +342,8 @@ class GBTree:
             # eager (prune runs only when gamma > 0)
             deltas = jnp.zeros((binned.shape[0], K), jnp.float32)
             for i in range(T):
-                tree, resolve = prune_tree(new_trees[i], self.param.gamma)
+                tree, resolve = prune_tree(new_trees[i], self.param.gamma,
+                                           self.cfg.n_roots)
                 d = tree.leaf_value[jnp.asarray(resolve)[row_leafs[i]]]
                 if row_valid is not None:
                     d = d * row_valid.astype(d.dtype)
@@ -389,7 +418,7 @@ class GBTree:
                             stacks)
         group_new = [j // npar for _ in range(n_rounds)
                      for j in range(K * npar)]
-        if self.trees:
+        if self.num_trees:
             old_stack, old_group = self._stack(0)
             full = jax.tree.map(lambda a, b: jnp.concatenate([a, b]),
                                 old_stack, flat)
@@ -399,9 +428,19 @@ class GBTree:
             full = flat
             full_group = jnp.asarray(group_new, jnp.int32)
         T_new = n_rounds * K * npar
-        self.trees.extend(_unstack_trees(flat, T_new))
+        # keep the new trees STACKED (ADVICE r2: eager unstack compiles a
+        # T-output program per distinct T and duplicates the cached
+        # stack); the trees property slices lazily if anything needs
+        # per-tree objects
+        if self._pending is not None:
+            old_flat, old_t = self._pending
+            self._pending = (jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b]), old_flat, flat),
+                old_t + T_new)
+        else:
+            self._pending = (flat, T_new)
         self.tree_group.extend(group_new)
-        self._stack_cache = (len(self.trees), full, full_group)
+        self._stack_cache = (self.num_trees, full, full_group)
         return margin_f
 
     # ----------------------------------------------------------- paged boost
@@ -417,6 +456,10 @@ class GBTree:
         from xgboost_tpu.external import _paged_leaf_delta, grow_tree_paged
         from xgboost_tpu.models.updaters import parse_updaters, prune_tree
 
+        if self.cfg.n_roots > 1:
+            raise NotImplementedError(
+                "num_roots > 1 is not supported on external-memory "
+                "matrices (root_index routing is in-memory only)")
         do_prune = ("prune" in parse_updaters(self.param.updater)
                     and self.param.gamma > 0.0)
         K = max(1, self.param.num_output_group)
@@ -445,7 +488,8 @@ class GBTree:
 
     # --------------------------------------------------------------- refresh
     def do_refresh(self, binned: jax.Array, gh: jax.Array,
-                   row_valid: Optional[jax.Array] = None, mesh=None) -> None:
+                   row_valid: Optional[jax.Array] = None, mesh=None,
+                   root: Optional[jax.Array] = None) -> None:
         """Refresh all trees' stats/leaf values on (new) data — the
         reference's ``updater=refresh`` continued-training mode
         (updater_refresh-inl.hpp:19-151)."""
@@ -453,6 +497,10 @@ class GBTree:
 
         if mesh is not None:
             from xgboost_tpu.parallel.dp import refresh_tree_dp
+            if root is not None:
+                raise NotImplementedError(
+                    "refresh with root_index under dsplit=row is not "
+                    "wired; refresh single-device or drop root_index")
         for i, tree in enumerate(self.trees):
             k = self.tree_group[i]
             if mesh is not None:
@@ -462,7 +510,8 @@ class GBTree:
             else:
                 self.trees[i] = refresh_tree(
                     tree, binned, gh[:, k, :], self.cfg.split,
-                    self.cfg.max_depth, row_valid)
+                    self.cfg.max_depth, row_valid,
+                    root=root, n_roots=self.cfg.n_roots)
         self._stack_cache = None
 
     # -------------------------------------------------------------- predict
@@ -479,15 +528,18 @@ class GBTree:
         return stack, group
 
     def predict_margin(self, binned: jax.Array, base: jax.Array,
-                       ntree_limit: int = 0) -> jax.Array:
+                       ntree_limit: int = 0,
+                       root: Optional[jax.Array] = None) -> jax.Array:
         stack, group = self._stack(ntree_limit)
         return predict_margin_binned(
             stack, group, binned, base, self.cfg.max_depth,
-            max(1, self.param.num_output_group))
+            max(1, self.param.num_output_group),
+            root=root, n_roots=self.cfg.n_roots)
 
     def predict_incremental(self, binned: jax.Array, margin: jax.Array,
                             new_trees: List[TreeArrays],
-                            first_group: int = 0) -> jax.Array:
+                            first_group: int = 0,
+                            root: Optional[jax.Array] = None) -> jax.Array:
         """Add the contribution of freshly grown trees to a cached margin
         (fixed shapes per round -> single compilation)."""
         K = max(1, self.param.num_output_group)
@@ -498,11 +550,14 @@ class GBTree:
             dtype=jnp.int32)
         return predict_margin_binned(
             stack, group, binned, jnp.zeros((), jnp.float32),
-            self.cfg.max_depth, K) + margin
+            self.cfg.max_depth, K,
+            root=root, n_roots=self.cfg.n_roots) + margin
 
-    def predict_leaf(self, binned: jax.Array, ntree_limit: int = 0) -> jax.Array:
+    def predict_leaf(self, binned: jax.Array, ntree_limit: int = 0,
+                     root: Optional[jax.Array] = None) -> jax.Array:
         stack, _ = self._stack(ntree_limit)
-        return predict_leaf_binned(stack, binned, self.cfg.max_depth)
+        return predict_leaf_binned(stack, binned, self.cfg.max_depth,
+                                   root=root, n_roots=self.cfg.n_roots)
 
     # ------------------------------------------------------------ serialize
     def get_state(self) -> dict:
@@ -521,7 +576,11 @@ class GBTree:
         stack = TreeArrays(**{f: jnp.asarray(state[f"tree_{f}"])
                               for f in TreeArrays._fields})
         T = stack.feature.shape[0]
-        for i in range(T):
-            gbt.trees.append(jax.tree.map(lambda x: x[i], stack))
+        # stay stacked: prediction/save go through the stack cache; only
+        # dump/refresh/prune-style per-tree access slices lazily
+        gbt._pending = (stack, T)
         gbt.tree_group = [int(g) for g in state["tree_group_arr"]]
+        gbt._stack_cache = (T, stack,
+                            jnp.asarray(state["tree_group_arr"],
+                                        dtype=jnp.int32))
         return gbt

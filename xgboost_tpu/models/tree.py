@@ -51,6 +51,12 @@ class GrowConfig(NamedTuple):
     subsample: float = 1.0
     colsample_bytree: float = 1.0
     colsample_bylevel: float = 1.0
+    hist_precision: str = "auto"  # auto | fp32 | bf16 (named TrainParam)
+    # multi-root trees (reference TreeParam num_roots, data.h root_index):
+    # the top ceil(log2 n_roots) levels of the perfect layout are root
+    # slots; row i enters at node (2**d0 - 1) + root_index[i], matching
+    # RegTree::GetLeafIndex(feat, root_id) semantics (model.h:534-543)
+    n_roots: int = 1
 
 
 class SplitDecision(NamedTuple):
@@ -149,8 +155,13 @@ def _default_feat_sampler(key, rate, binned):
     return _sample_features(key, binned.shape[1], rate)
 
 
-def tree_capacity(max_depth: int) -> int:
-    return 2 ** (max_depth + 1) - 1
+def root_level(n_roots: int) -> int:
+    """Depth of the level holding the root slots (0 for a single root)."""
+    return max(n_roots - 1, 0).bit_length()
+
+
+def tree_capacity(max_depth: int, n_roots: int = 1) -> int:
+    return 2 ** (root_level(n_roots) + max_depth + 1) - 1
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -159,7 +170,8 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
               cut_values: jax.Array, n_cuts: jax.Array, cfg: GrowConfig,
               row_valid: Optional[jax.Array] = None,
               hist_reduce: Callable[[jax.Array], jax.Array] = None,
-              split_finder=None, router=None, feat_sampler=None):
+              split_finder=None, router=None, feat_sampler=None,
+              root: Optional[jax.Array] = None):
     """Grow one tree level-by-level.
 
     Args:
@@ -169,6 +181,8 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
       cut_values: (F, C) padded raw cut values, n_cuts: (F,).
       row_valid: optional (N,) bool — rows that belong to this shard/set
         (padding rows excluded from both stats and leaf assignment).
+      root: optional (N,) int32 per-row root slot in [0, cfg.n_roots)
+        (reference BoosterInfo root_index, data.h:39-58); None = root 0.
       hist_reduce: collective reduction applied to every histogram and
         node-stat tensor (identity when None; psum over 'data' in DP mode).
       split_finder/router/feat_sampler: the collective seams for
@@ -179,7 +193,7 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
     """
     N, F = binned.shape
     D = cfg.max_depth
-    n_total = tree_capacity(D)
+    d0 = root_level(cfg.n_roots)  # growth starts at the root-slot level
     red = hist_reduce if hist_reduce is not None else (lambda x: x)
     if split_finder is None:
         split_finder = _default_split_finder
@@ -205,25 +219,33 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
     # guaranteed non-empty fallback.
     feat_mask_tree = feat_sampler(key_ftree, cfg.colsample_bytree, binned)
 
-    tree = empty_tree(D)
+    tree = empty_tree(D, cfg.n_roots)
 
-    pos = jnp.zeros(N, jnp.int32)  # level-local position; -1 = parked in a leaf
+    # level-local position at depth d0; -1 = parked in a leaf.  With one
+    # root this is all zeros; multi-root rows start in their root slot
+    # (the reference initializes position from root_index,
+    # updater_colmaker-inl.hpp:115-146 / basemaker InitData).
+    if root is not None and d0 > 0:
+        pos = jnp.clip(root.astype(jnp.int32), 0, cfg.n_roots - 1)
+    else:
+        pos = jnp.zeros(N, jnp.int32)
     if row_valid is not None:
         pos = jnp.where(row_valid, pos, -1)
     row_leaf = jnp.zeros(N, jnp.int32)
 
-    for depth in range(D + 1):
+    for depth in range(d0, d0 + D + 1):
         n_node = 1 << depth
         base = n_node - 1  # global index of first node at this level
 
-        if depth == D:
+        if depth == d0 + D:
             # terminal level: everything still active becomes a leaf
             nst = red(node_stats(gh_used, pos, n_node))  # (n_node, 2)
             make_leaf = jnp.ones(n_node, jnp.bool_)
             best = None
         else:
             hist = red(build_level_histogram(binned, gh_used, pos,
-                                             n_node, cfg.n_bin))
+                                             n_node, cfg.n_bin,
+                                             cfg.hist_precision))
             # node totals fall out of the histogram (bin sums of any one
             # feature) — saves a per-level pass over all rows
             nst = stats_from_histogram(hist)
@@ -291,9 +313,9 @@ def apply_level(tree: TreeArrays, depth: int, nst: jax.Array,
     return tree
 
 
-def empty_tree(max_depth: int) -> TreeArrays:
+def empty_tree(max_depth: int, n_roots: int = 1) -> TreeArrays:
     """All-unused tree arrays for a depth-``max_depth`` perfect layout."""
-    n_total = tree_capacity(max_depth)
+    n_total = tree_capacity(max_depth, n_roots)
     return TreeArrays(
         feature=jnp.full(n_total, -1, jnp.int32),
         cut_index=jnp.zeros(n_total, jnp.int32),
@@ -318,14 +340,25 @@ def _sample_features(key: jax.Array, F: int, rate: float) -> jax.Array:
 
 # ---------------------------------------------------------------- traversal
 
-def _traverse_one(tree: TreeArrays, binned: jax.Array, max_depth: int):
+def _traverse_one(tree: TreeArrays, binned: jax.Array, max_depth: int,
+                  root: Optional[jax.Array] = None, n_roots: int = 1):
     """Leaf index per row for one tree on binned data.
 
     Matches reference RegTree::GetLeafIndex / GetNext (model.h:534-566)
-    including missing-value default direction.
+    including missing-value default direction; with ``root`` (the
+    per-row root_index, data.h:39-58) traversal starts at that root
+    slot instead of node 0.
     """
     # derive from binned so the row sharding (dsplit=row) carries over
     node = jnp.zeros_like(binned[:, 0], dtype=jnp.int32)
+    if n_roots > 1:
+        # ALWAYS offset into the root-slot level: nodes above it are
+        # synthetic placeholders; root=None means "everyone at root 0"
+        # (consistent with growth, where pos=0 is slot 0 of that level)
+        d0 = root_level(n_roots)
+        node = node + (1 << d0) - 1
+        if root is not None:
+            node = node + jnp.clip(root.astype(jnp.int32), 0, n_roots - 1)
     for _ in range(max_depth):
         f = tree.feature[node]
         leaf = tree.is_leaf[node] | (f < 0)
@@ -337,10 +370,13 @@ def _traverse_one(tree: TreeArrays, binned: jax.Array, max_depth: int):
     return node
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth", "n_group"))
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_group",
+                                             "n_roots"))
 def predict_margin_binned(stack: TreeArrays, tree_group: jax.Array,
                           binned: jax.Array, base: jax.Array,
-                          max_depth: int, n_group: int) -> jax.Array:
+                          max_depth: int, n_group: int,
+                          root: Optional[jax.Array] = None,
+                          n_roots: int = 1) -> jax.Array:
     """Sum of leaf values over a (T, n_nodes) stacked ensemble.
 
     Scanned over trees so one compilation serves any ensemble size with
@@ -350,7 +386,7 @@ def predict_margin_binned(stack: TreeArrays, tree_group: jax.Array,
 
     def body(margin, tg):
         tree, group = tg
-        leaf = _traverse_one(tree, binned, max_depth)
+        leaf = _traverse_one(tree, binned, max_depth, root, n_roots)
         contrib = tree.leaf_value[leaf]
         margin = margin + contrib[:, None] * jax.nn.one_hot(
             group, n_group, dtype=margin.dtype)
@@ -361,12 +397,13 @@ def predict_margin_binned(stack: TreeArrays, tree_group: jax.Array,
     return margin
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth",))
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_roots"))
 def predict_leaf_binned(stack: TreeArrays, binned: jax.Array,
-                        max_depth: int) -> jax.Array:
+                        max_depth: int, root: Optional[jax.Array] = None,
+                        n_roots: int = 1) -> jax.Array:
     """(N, T) leaf node index per tree (reference PredictLeaf,
     gbtree-inl.hpp:355-385)."""
     def body(_, tree):
-        return None, _traverse_one(tree, binned, max_depth)
+        return None, _traverse_one(tree, binned, max_depth, root, n_roots)
     _, leaves = jax.lax.scan(body, None, stack)
     return leaves.T

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from xgboost_tpu.models.tree import TreeArrays, bin_of_feature
+from xgboost_tpu.models.tree import TreeArrays, bin_of_feature, root_level
 from xgboost_tpu.ops.split import SplitConfig, calc_gain, calc_weight
 
 KNOWN_UPDATERS = ("grow_colmaker", "grow_histmaker", "grow_skmaker",
@@ -50,7 +50,8 @@ def parse_updaters(updater: str) -> Tuple[str, ...]:
 
 
 # ------------------------------------------------------------------- prune
-def prune_tree(tree: TreeArrays, gamma: float) -> Tuple[TreeArrays, np.ndarray]:
+def prune_tree(tree: TreeArrays, gamma: float,
+               n_roots: int = 1) -> Tuple[TreeArrays, np.ndarray]:
     """Bottom-up post-prune (reference TreePruner::TryPruneLeaf,
     updater_prune-inl.hpp:42-72): a split node whose children are both
     leaves and whose loss_chg < gamma becomes a leaf, recursively.
@@ -78,9 +79,14 @@ def prune_tree(tree: TreeArrays, gamma: float) -> Tuple[TreeArrays, np.ndarray]:
             gain[nid] = 0.0
 
     resolve = np.arange(n, dtype=np.int32)
-    # top-down: a node under a pruned ancestor resolves to that ancestor
+    # top-down: a node under a pruned ancestor resolves to that ancestor.
+    # Multi-root trees: nodes ABOVE the root-slot level are synthetic
+    # (never-split placeholders) — root slots must not resolve into them.
+    start_real = (1 << root_level(n_roots)) - 1
     for nid in range(1, n):
         parent = (nid - 1) // 2
+        if parent < start_real:
+            continue
         if is_leaf[resolve[parent]] or feature[resolve[parent]] < 0:
             resolve[nid] = resolve[parent]
 
@@ -93,11 +99,13 @@ def prune_tree(tree: TreeArrays, gamma: float) -> Tuple[TreeArrays, np.ndarray]:
 
 
 # ----------------------------------------------------------------- refresh
-@functools.partial(jax.jit, static_argnames=("cfg", "max_depth", "hist_reduce"))
+@functools.partial(jax.jit, static_argnames=("cfg", "max_depth",
+                                             "hist_reduce", "n_roots"))
 def refresh_tree(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
                  cfg: SplitConfig, max_depth: int,
                  row_valid: Optional[jax.Array] = None,
-                 hist_reduce: Callable[[jax.Array], jax.Array] = None
+                 hist_reduce: Callable[[jax.Array], jax.Array] = None,
+                 root: Optional[jax.Array] = None, n_roots: int = 1
                  ) -> TreeArrays:
     """Recompute one tree's node stats + leaf values from (new) data
     (reference TreeRefresher, updater_refresh-inl.hpp:19-151: stream rows
@@ -118,8 +126,14 @@ def refresh_tree(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
     if row_valid is not None:
         gh_used = gh_used * row_valid[:, None].astype(gh.dtype)
 
-    # accumulate (G, H) at every node on each row's root->leaf path
+    # accumulate (G, H) at every node on each row's root->leaf path;
+    # multi-root trees always offset into the root-slot level (root=None
+    # = slot 0, matching growth and traversal)
     node = jnp.zeros_like(binned[:, 0], dtype=jnp.int32)
+    if n_roots > 1:
+        node = node + (1 << root_level(n_roots)) - 1
+        if root is not None:
+            node = node + jnp.clip(root.astype(jnp.int32), 0, n_roots - 1)
     acc = jnp.zeros((n_nodes, 2), jnp.float32)
     for _ in range(max_depth + 1):
         acc = acc.at[node].add(gh_used)

@@ -28,29 +28,34 @@ def _psum_data(x):
 
 
 def grow_tree_dp(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
-                 cfg: GrowConfig, row_valid, split_finder=None):
+                 cfg: GrowConfig, row_valid, split_finder=None, root=None):
     """Grow one tree with rows sharded over mesh axis 'data'.
 
     binned: (N, F) with N divisible by mesh size; gh: (N, 2);
-    row_valid: (N,) bool marking real (non-padding) rows.
+    row_valid: (N,) bool marking real (non-padding) rows;
+    root: optional (N,) int32 per-row root slot (multi-root trees).
     Returns (tree [replicated], row_leaf (N,) [sharded]).
     """
-    def body(key, binned, gh, cut_values, n_cuts, row_valid):
+    def body(key, binned, gh, cut_values, n_cuts, row_valid, root):
         tree, row_leaf = grow_tree(key, binned, gh, cut_values, n_cuts, cfg,
                                    row_valid, hist_reduce=_psum_data,
-                                   split_finder=split_finder)
+                                   split_finder=split_finder,
+                                   root=root if cfg.n_roots > 1 else None)
         # leaf-value gather stays inside the shard: indices are shard-local
         return tree, row_leaf, tree.leaf_value[row_leaf]
 
+    if root is None:
+        root = jnp.zeros(binned.shape[0], jnp.int32)
     # check_vma=False: the Pallas histogram kernel's out_shape carries no
     # vma annotation, and the psum'd tree outputs are replicated anyway
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS),
+                  P(DATA_AXIS)),
         out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
         check_vma=False,
     )
-    return fn(key, binned, gh, cut_values, n_cuts, row_valid)
+    return fn(key, binned, gh, cut_values, n_cuts, row_valid, root)
 
 
 def refresh_tree_dp(mesh: Mesh, tree, binned, gh, split_cfg, max_depth,

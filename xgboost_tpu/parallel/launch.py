@@ -60,8 +60,21 @@ def init_worker(local_device_count: Optional[int] = None) -> bool:
     coord = os.environ.get(COORD_ENV)
     if not coord:
         return False
-    n = int(os.environ[NWORKER_ENV])
-    rank = int(os.environ[RANK_ENV])
+    if RANK_ENV in os.environ:
+        n = int(os.environ[NWORKER_ENV])
+        rank = int(os.environ[RANK_ENV])
+    else:
+        # scheduler-launched worker (mpirun/srun/qsub via
+        # parallel/submit.py): rank/world come from the scheduler's env
+        from xgboost_tpu.parallel.submit import scheduler_rank
+        rw = scheduler_rank()
+        if rw is None:
+            raise RuntimeError(
+                f"{COORD_ENV} is set but no rank source found: export "
+                f"{RANK_ENV}/{NWORKER_ENV} or launch under a scheduler "
+                "(OpenMPI/PMI/Slurm/SGE)")
+        rank, sched_n = rw
+        n = int(os.environ.get(NWORKER_ENV, sched_n))
     if local_device_count is None and os.environ.get("XGBTPU_LOCAL_DEVICES"):
         local_device_count = int(os.environ["XGBTPU_LOCAL_DEVICES"])
     if local_device_count is not None:

@@ -1,0 +1,159 @@
+"""Cluster job submitters — the rabit submitter scripts' analog.
+
+The reference ships per-scheduler submit glue that starts N workers with
+rank/world env vars and a tracker address
+(``subtree/rabit/tracker/rabit_mpi.py``, ``rabit_sge.py``,
+``rabit_yarn.py`` + the YARN Java client).  Under JAX the tracker is the
+``jax.distributed`` coordinator (process 0), so a submitter only needs
+to (a) start the same worker command N times on the cluster and (b) let
+each worker discover (coordinator, world, rank).  Rank/world come either
+from the explicit ``XGBTPU_*`` env contract or from the scheduler's own
+variables (``init_worker`` understands OpenMPI/PMI/Slurm/SGE — see
+:func:`scheduler_rank`).
+
+Usage (mirrors ``rabit_*.py submit(nworker, cmd)``):
+
+    python -m xgboost_tpu.parallel.submit -n 8 --mode mpi \
+        --coord host0:9876 -- python -m xgboost_tpu train.conf
+
+``--mode local`` delegates to the in-tree gang launcher;
+``--dry-run`` prints the scheduler command instead of executing it
+(what the tests assert — no scheduler lives in CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import tempfile
+from typing import List, Optional, Tuple
+
+from xgboost_tpu.parallel.launch import (COORD_ENV, NWORKER_ENV, RANK_ENV,
+                                         free_port, launch_local)
+
+# scheduler-provided rank/world variables, in resolution order
+_RANK_VARS = ("OMPI_COMM_WORLD_RANK", "PMIX_RANK", "PMI_RANK",
+              "SLURM_PROCID")
+_WORLD_VARS = ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "SLURM_NTASKS")
+
+
+def scheduler_rank() -> Optional[Tuple[int, int]]:
+    """(rank, world) from scheduler env vars, or None.
+
+    SGE array jobs number tasks from 1 (``SGE_TASK_ID``); MPI/Slurm
+    ranks start at 0.
+    """
+    for rv in _RANK_VARS:
+        if rv in os.environ:
+            rank = int(os.environ[rv])
+            for wv in _WORLD_VARS:
+                if wv in os.environ:
+                    return rank, int(os.environ[wv])
+    if "SGE_TASK_ID" in os.environ and "SGE_TASK_LAST" in os.environ:
+        return (int(os.environ["SGE_TASK_ID"]) - 1,
+                int(os.environ["SGE_TASK_LAST"]))
+    return None
+
+
+def mpi_command(n: int, coord: str, cmd: List[str]) -> List[str]:
+    """mpirun line exporting the env contract (rabit_mpi.py role): the
+    coordinator address is fixed at submit time; each worker takes its
+    rank from OMPI/PMI vars."""
+    return (["mpirun", "-n", str(n),
+             "-x", f"{COORD_ENV}={coord}",
+             "-x", f"{NWORKER_ENV}={n}"] + cmd)
+
+
+def sge_script(n: int, coord: str, cmd: List[str]) -> str:
+    """qsub array-job script text (rabit_sge.py role): task ids 1..N map
+    to ranks 0..N-1 via SGE_TASK_ID."""
+    quoted = " ".join(shlex.quote(c) for c in cmd)
+    return (
+        "#!/bin/bash\n"
+        f"#$ -t 1-{n}\n"
+        "#$ -cwd\n"
+        f"export {COORD_ENV}={shlex.quote(coord)}\n"
+        f"export {NWORKER_ENV}={n}\n"
+        f"export {RANK_ENV}=$((SGE_TASK_ID-1))\n"
+        f"exec {quoted}\n")
+
+
+def slurm_command(n: int, coord: str, cmd: List[str]) -> List[str]:
+    """srun line (the modern scheduler the reference predates); ranks
+    come from SLURM_PROCID."""
+    return (["srun", f"--ntasks={n}",
+             f"--export=ALL,{COORD_ENV}={coord},{NWORKER_ENV}={n}"] + cmd)
+
+
+def submit(n: int, cmd: List[str], mode: str = "local",
+           coord: Optional[str] = None, keepalive: bool = False,
+           dry_run: bool = False) -> int:
+    """Submit ``cmd`` as an ``n``-worker distributed job."""
+    if mode == "local":
+        if dry_run:
+            print(f"[submit] local gang: {n} x {' '.join(cmd)}")
+            return 0
+        return launch_local(n, cmd, keepalive=keepalive)
+    if coord is None:
+        # the submit host fronts the coordinator only in mode=mpi when
+        # rank 0 lands on this host; schedulers need an explicit --coord
+        if mode == "mpi":
+            coord = f"{os.uname().nodename}:{free_port()}"
+        else:
+            raise ValueError(
+                f"--mode {mode} needs --coord host:port (the address "
+                "where rank 0's jax.distributed coordinator will listen)")
+    if mode == "mpi":
+        line = mpi_command(n, coord, cmd)
+        if dry_run:
+            print(" ".join(shlex.quote(c) for c in line))
+            return 0
+        return subprocess.call(line)
+    if mode == "sge":
+        script = sge_script(n, coord, cmd)
+        if dry_run:
+            print(script, end="")
+            return 0
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".sh", delete=False) as f:
+            f.write(script)
+            path = f.name
+        return subprocess.call(["qsub", path])
+    if mode == "slurm":
+        line = slurm_command(n, coord, cmd)
+        if dry_run:
+            print(" ".join(shlex.quote(c) for c in line))
+            return 0
+        return subprocess.call(line)
+    raise ValueError(f"unknown submit mode {mode!r} "
+                     "(local | mpi | sge | slurm)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m xgboost_tpu.parallel.submit",
+        description="submit an N-worker distributed job "
+                    "(rabit_mpi/sge submitter analog)")
+    ap.add_argument("-n", "--nworker", type=int, required=True)
+    ap.add_argument("--mode", default="local",
+                    choices=("local", "mpi", "sge", "slurm"))
+    ap.add_argument("--coord", default=None,
+                    help="host:port for the jax.distributed coordinator")
+    ap.add_argument("--keepalive", action="store_true")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the scheduler command, do not execute")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if args.cmd and args.cmd[0] == "--":
+        args.cmd = args.cmd[1:]
+    if not args.cmd:
+        ap.error("missing worker command")
+    return submit(args.nworker, args.cmd, mode=args.mode, coord=args.coord,
+                  keepalive=args.keepalive, dry_run=args.dry_run)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

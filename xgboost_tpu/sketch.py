@@ -91,9 +91,21 @@ def make_summary(values: np.ndarray, weights: np.ndarray | None = None) -> Quant
     """
     values = np.asarray(values, dtype=np.float64).ravel()
     if weights is None:
-        weights = np.ones_like(values)
-    else:
-        weights = np.asarray(weights, dtype=np.float64).ravel()
+        # unweighted fast path: a plain value sort + run-length counts;
+        # the general path's stable argsort + ufunc.at dominated
+        # external-memory sketch ingest (~8x slower per column)
+        values = values[np.isfinite(values)]
+        if values.size == 0:
+            return empty_summary()
+        v = np.sort(values)
+        edges = np.flatnonzero(
+            np.concatenate([[True], v[1:] != v[:-1]]))
+        gv = v[edges]
+        gw = np.diff(np.concatenate(
+            [edges, [v.size]])).astype(np.float64)
+        rmax = np.cumsum(gw)
+        return QuantileSummary(gv, rmax - gw, rmax, gw)
+    weights = np.asarray(weights, dtype=np.float64).ravel()
     mask = np.isfinite(values) & (weights > 0)
     values, weights = values[mask], weights[mask]
     if values.size == 0:
