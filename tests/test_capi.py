@@ -67,4 +67,7 @@ def test_c_host_end_to_end(demo_bin, tmp_path):
                      "eta": 1.0}, dtrain, 2, verbose_eval=False)
     want = float(np.asarray(bst.predict(dtest))[0])
     got = float(out.split("pred0=")[1].split()[0])
-    assert abs(got - want) < 1e-5
+    # the C driver runs in its own process (different XLA flag set than
+    # the 8-virtual-device conftest here), so float summation order may
+    # differ in the last bits; %g printing adds ~1e-6 quantization
+    assert abs(got - want) < 5e-5

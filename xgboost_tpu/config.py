@@ -85,6 +85,12 @@ class TrainParam:
     # "fp32" forces exact-f32 histograms; "bf16" forces the MXU pass.
     # XGBTPU_HIST remains an env override (test seam).
     hist_precision: str = "auto"
+    # histogram subtraction + row compaction: build only the smaller
+    # child per parent, derive the sibling as parent - small.  -1 auto
+    # resolves to OFF — measured on v5e, XLA row compaction costs an
+    # order of magnitude more than the kernel time it saves
+    # (PROFILE.md round 3); 1 forces it on (numerics tested equal).
+    hist_subtraction: int = -1
     # gblinear coordinate-descent block size: 1 = exact sequential CD
     # (convergent under feature correlation); >1 = shotgun-style parallel
     # updates within each block (reference gblinear-inl.hpp:76-105)
@@ -124,6 +130,10 @@ class TrainParam:
     # -- ranking objective params (reference src/learner/objective-inl.hpp:283-300)
     num_pairsample: int = 1
     fix_list_weight: float = 0.0
+    # rank gradient implementation: "device" = on-device pair sampling +
+    # delta weights (rank_device.py; fused-scan eligible, no per-round
+    # host transfer); "host" = reference-faithful numpy path
+    rank_impl: str = "device"
 
     # unknown/extra params are preserved (the reference tolerates and
     # forwards unrecognized names through SetParam cascades)

@@ -39,7 +39,8 @@ import numpy as np
 
 from xgboost_tpu.data import DMatrix, MetaInfo, load_meta_sidecars
 from xgboost_tpu.models.tree import (GrowConfig, TreeArrays, _traverse_one,
-                                     apply_level, bin_of_feature, empty_tree)
+                                     apply_level, bin_of_feature, empty_tree,
+                                     table_lookup)
 from xgboost_tpu.ops.histogram import build_level_histogram, node_stats
 from xgboost_tpu.ops.split import find_best_splits
 from xgboost_tpu.sketch import (QuantileSummary, empty_summary, make_summary,
@@ -350,15 +351,7 @@ class ExtMemDMatrix:
         if env is not None:
             budget = int(env) << 20
         else:
-            budget = 2048 << 20
-            try:
-                stats = jax.devices()[0].memory_stats() or {}
-                limit = stats.get("bytes_limit")
-                if limit:
-                    free = limit - stats.get("bytes_in_use", 0)
-                    budget = max(free // 2, 0)
-            except Exception:
-                pass  # backends without memory_stats keep the default
+            budget = _default_device_budget()
         total = (self.num_row * self._binned_mm.shape[1]
                  * self._binned_mm.dtype.itemsize)
         return total <= budget
@@ -368,6 +361,31 @@ class ExtMemDMatrix:
         in-budget case never reaches here — see fits_device_budget)."""
         for start, b in self.binned_batches():
             yield start, jnp.asarray(b)
+
+
+_budget_cache: Optional[int] = None
+
+
+def _default_device_budget() -> int:
+    """Deterministic per-process device budget: half the free device
+    memory sampled ONCE (repeated queries would let allocation state
+    flip identical matrices between streamed and in-memory paths), and
+    the fixed 2048MB default in multi-process jobs — ranks computing
+    different budgets would pick different collective sequences."""
+    global _budget_cache
+    if _budget_cache is None:
+        budget = 2048 << 20
+        if jax.process_count() == 1:
+            try:
+                stats = jax.devices()[0].memory_stats() or {}
+                limit = stats.get("bytes_limit")
+                if limit:
+                    free = limit - stats.get("bytes_in_use", 0)
+                    budget = max(free // 2, 0)
+            except Exception:
+                pass  # backends without memory_stats keep the default
+        _budget_cache = budget
+    return _budget_cache
 
 
 # ------------------------------------------------------------- paged grow
@@ -380,11 +398,11 @@ def _paged_level_hist(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
     node = jnp.zeros_like(binned[:, 0], dtype=jnp.int32)
     alive = jnp.ones(binned.shape[0], jnp.bool_)
     for _ in range(depth):
-        f = tree.feature[node]
-        at_leaf = tree.is_leaf[node] | (f < 0)
+        f = table_lookup(tree.feature, node)
+        at_leaf = table_lookup(tree.is_leaf, node) | (f < 0)
         b = bin_of_feature(binned, jnp.maximum(f, 0))
-        go_left = jnp.where(b == 0, tree.default_left[node],
-                            b <= tree.cut_index[node] + 1)
+        go_left = jnp.where(b == 0, table_lookup(tree.default_left, node),
+                            b <= table_lookup(tree.cut_index, node) + 1)
         nxt = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
         alive = alive & ~at_leaf
         node = jnp.where(at_leaf, node, nxt)
@@ -396,7 +414,8 @@ def _paged_level_hist(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def _paged_leaf_delta(tree: TreeArrays, binned: jax.Array, max_depth: int):
-    return tree.leaf_value[_traverse_one(tree, binned, max_depth)]
+    return table_lookup(tree.leaf_value,
+                        _traverse_one(tree, binned, max_depth))
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "n_bin", "mesh",

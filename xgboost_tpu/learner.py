@@ -86,6 +86,7 @@ class Booster:
         self.obj.set_param("num_class", self.param.num_class)
         self.obj.set_param("num_pairsample", self.param.num_pairsample)
         self.obj.set_param("fix_list_weight", self.param.fix_list_weight)
+        self.obj.set_param("rank_impl", self.param.rank_impl)
 
     def _reconfigure(self):
         """Propagate changed params into live objective/booster state, so
@@ -128,11 +129,12 @@ class Booster:
                             "cuts at every distinct value, which no "
                             "process can propose from a row shard; load "
                             "replicated for exact-greedy training")
-                    if getattr(self.obj, "needs_host_margin", False):
+                    if self.param.objective.startswith("rank:"):
                         raise NotImplementedError(
-                            "ranking objectives need the full margin "
-                            "and group structure on each host; load "
-                            "replicated for rank:* training")
+                            "ranking objectives need global group "
+                            "structure, which row-block split loading "
+                            "cannot provide; load replicated for "
+                            "rank:* training")
                     from xgboost_tpu.parallel.sketch_device import \
                         sketch_cuts_global
                     self._mesh = dtrain.mesh
@@ -262,7 +264,16 @@ class Booster:
                 self._cache[key] = _CacheEntry(
                     dmat, binned, self._base_margin_of(dmat, dmat.num_row))
             self._attach_root(self._cache[key], dmat)
-        return self._cache[key]
+        entry = self._cache[key]
+        if (entry.info is dmat.info
+                and entry.info_version != dmat.info.version):
+            # plain entries SHARE the MetaInfo: label/weight freshness
+            # rides info._dev_cache invalidation, but entry.root is an
+            # entry-level snapshot — refresh it on any set_field
+            entry.root = None
+            self._attach_root(entry, dmat)
+            entry.info_version = dmat.info.version
+        return entry
 
     def _attach_root(self, entry: _CacheEntry, dmat) -> None:
         """Per-row root slots (multi-root trees, reference root_index
@@ -270,6 +281,11 @@ class Booster:
         ri = getattr(dmat.info, "root_index", None)
         if ri is None or max(1, self.param.num_roots) <= 1:
             return
+        if getattr(dmat, "is_sharded", False):
+            raise NotImplementedError(
+                "root_index on split-loaded matrices is not supported "
+                "(per-rank placement of the root vector is unwired); "
+                "load replicated for multi-root training")
         if entry.external:
             raise NotImplementedError(
                 "root_index on external-memory matrices is not supported")
@@ -358,10 +374,11 @@ class Booster:
         to :meth:`_make_sharded_entry`'s device placement of a
         replicated load over the same mesh, so training produces
         byte-identical models (tested in tests/test_launch.py)."""
-        if getattr(self.obj, "needs_host_margin", False):
+        if self.param.objective.startswith("rank:"):
             raise NotImplementedError(
-                "ranking objectives need the full margin and group "
-                "structure on each host; load replicated for rank:*")
+                "ranking objectives need global group structure, which "
+                "row-block split loading cannot provide; load "
+                "replicated for rank:*")
         n_loc = dmat.local_num_row
         K = self._K
         binned_local = bin_matrix(dmat._local, self.gbtree.cuts)
@@ -568,7 +585,7 @@ class Booster:
             and max(1, self.param.num_roots) == 1
             and "refresh" not in ups
             and any(u.startswith("grow") for u in ups)
-            and self.obj.fused_grad() is not None)
+            and self.obj.fused_grad(entry.info) is not None)
         if not fused_ok:
             for i in range(first_iteration, first_iteration + n_rounds):
                 self.update(dtrain, i, fobj)
@@ -576,7 +593,8 @@ class Booster:
         self.obj.validate_labels(entry.info)  # host check, once per info
         self._sync_margin(entry)
         entry.margin = self.gbtree.do_boost_fused(
-            entry.binned, entry.margin, entry.info, self.obj.fused_grad(),
+            entry.binned, entry.margin, entry.info,
+            self.obj.fused_grad(entry.info),
             first_iteration, n_rounds, row_valid=entry.row_valid,
             mesh=self._mesh)
         entry.applied = self.gbtree.num_trees

@@ -23,7 +23,8 @@ from xgboost_tpu.binning import CutMatrix
 from xgboost_tpu.config import TrainParam
 from xgboost_tpu.models.tree import (GrowConfig, TreeArrays, grow_tree,
                                      predict_leaf_binned,
-                                     predict_margin_binned, tree_capacity)
+                                     predict_margin_binned, table_lookup,
+                                     tree_capacity)
 from xgboost_tpu.ops.split import SplitConfig
 
 
@@ -32,11 +33,21 @@ def make_grow_config(p: TrainParam, n_bin: int) -> GrowConfig:
         reg_lambda=p.reg_lambda, reg_alpha=p.reg_alpha,
         max_delta_step=p.max_delta_step, min_child_weight=p.min_child_weight,
         gamma=p.gamma, eta=p.eta, default_direction=p.default_direction)
+    hs = p.hist_subtraction
+    if hs < 0:
+        # auto: OFF.  Measured on v5e (PROFILE.md round 3): the MXU
+        # one-hot kernel's cost is per-row-tile, so subtraction only
+        # pays with row compaction — and XLA scatter/gather compaction
+        # costs 18-60 ms per level at 1M rows, an order of magnitude
+        # more than the ~5 ms/level it saves.  hist_subtraction=1
+        # forces it on (numerics tested equal; tests/test_updaters.py).
+        hs = 0
     return GrowConfig(split=split, max_depth=p.max_depth, n_bin=n_bin,
                       subsample=p.subsample,
                       colsample_bytree=p.colsample_bytree,
                       colsample_bylevel=p.colsample_bylevel,
                       hist_precision=p.hist_precision,
+                      hist_subtraction=bool(hs),
                       n_roots=max(1, p.num_roots))
 
 
@@ -57,7 +68,7 @@ def _vmapped_deltas(stacked, row_leafs, row_valid, K: int, npar: int,
     N = row_leafs.shape[1]
     deltas = jnp.zeros((N, K), jnp.float32)
     for i in range(K * npar):
-        d = stacked.leaf_value[i][row_leafs[i]]
+        d = table_lookup(stacked.leaf_value[i], row_leafs[i])
         if masked:
             d = d * row_valid.astype(d.dtype)
         deltas = deltas.at[:, i // npar].add(d)
@@ -90,7 +101,7 @@ def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
             tree, row_leaf = grow_tree(
                 tkey, binned, gh2, cut_values, n_cuts, cfg, row_valid,
                 split_finder=split_finder)
-            d = tree.leaf_value[row_leaf]
+            d = table_lookup(tree.leaf_value, row_leaf)
         if row_valid is not None:
             d = d * row_valid.astype(d.dtype)
         return tree, d
@@ -275,9 +286,10 @@ class GBTree:
                 if do_prune:
                     tree, resolve = prune_tree(tree, self.param.gamma,
                                                self.cfg.n_roots)
-                    d = tree.leaf_value[jnp.asarray(resolve)[row_leaf]]
+                    d = table_lookup(tree.leaf_value[jnp.asarray(resolve)],
+                                     row_leaf)
                 elif d is None:
-                    d = tree.leaf_value[row_leaf]
+                    d = table_lookup(tree.leaf_value, row_leaf)
                 if row_valid is not None:
                     # padding rows land on node 0, which carries the root's
                     # would-be leaf weight; zero their delta so their cached
@@ -344,7 +356,8 @@ class GBTree:
             for i in range(T):
                 tree, resolve = prune_tree(new_trees[i], self.param.gamma,
                                            self.cfg.n_roots)
-                d = tree.leaf_value[jnp.asarray(resolve)[row_leafs[i]]]
+                d = table_lookup(tree.leaf_value[jnp.asarray(resolve)],
+                                 row_leafs[i])
                 if row_valid is not None:
                     d = d * row_valid.astype(d.dtype)
                 new_trees[i] = tree

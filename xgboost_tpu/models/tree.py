@@ -52,6 +52,13 @@ class GrowConfig(NamedTuple):
     colsample_bytree: float = 1.0
     colsample_bylevel: float = 1.0
     hist_precision: str = "auto"  # auto | fp32 | bf16 (named TrainParam)
+    # histogram subtraction: per parent, build only the SMALLER child's
+    # histogram over row-compacted buffers and derive the sibling as
+    # parent - small (the reference builds every node's histogram,
+    # histmaker-inl.hpp:296-348; subtraction is the classic hist-method
+    # optimization).  Dense TPU tiles process masked rows at full cost,
+    # so the win requires the row compaction this flag also enables.
+    hist_subtraction: bool = False
     # multi-root trees (reference TreeParam num_roots, data.h root_index):
     # the top ceil(log2 n_roots) levels of the perfect layout are root
     # slots; row i enters at node (2**d0 - 1) + root_index[i], matching
@@ -70,6 +77,11 @@ class SplitDecision(NamedTuple):
     threshold: jax.Array     # (n_node,) f32 raw cut value
     valid: jax.Array         # (n_node,) bool
     owner: jax.Array         # (n_node,) int32 shard owning the feature
+    # optional left-child (G, H) of the chosen split — finders that
+    # provide them let the grower derive child node stats (terminal
+    # level) instead of running a node_stats pass over all rows
+    left_g: jax.Array = None
+    left_h: jax.Array = None
 
 
 def _default_split_finder(hist, nst, n_cuts, cut_values, fmask, split_cfg):
@@ -78,7 +90,8 @@ def _default_split_finder(hist, nst, n_cuts, cut_values, fmask, split_cfg):
     thr = cut_values[best.feature, best.cut_index]
     return SplitDecision(best.gain, best.feature, best.cut_index,
                          best.default_left, thr, best.valid,
-                         jnp.zeros_like(best.feature))
+                         jnp.zeros_like(best.feature),
+                         best.left_g, best.left_h)
 
 
 def _onehot_select(table: jax.Array, idx: jax.Array) -> jax.Array:
@@ -100,13 +113,16 @@ from jax.custom_batching import custom_vmap  # noqa: E402 (used below)
 def table_lookup(table: jax.Array, idx: jax.Array) -> jax.Array:
     """Per-row lookup in a small per-node table: ``table[idx]``.
 
-    Unbatched, XLA lowers this gather well.  Under ``jax.vmap`` (the
-    ensemble axis of vmapped growth) the batched gather lowers to a
-    ~12 ms/launch kCustom kernel on TPU — the dominant cost of the
-    vmapped grower (profiled; PROFILE.md round-2 second pass) — so the
-    batching rule swaps in a broadcast-compare select, which fuses.
+    Broadcast-compare select, NOT a gather: measured on v5e (round 3,
+    1M rows), XLA's dynamic gather costs 0.6-7.5 ms per launch for
+    16-1023-entry tables while the O(N*M) compare-select fuses to
+    0.05-0.9 ms — gathers only win past ~1024 entries (deep trees),
+    where the fallback below applies.  The vmap rule (ensemble axis of
+    vmapped growth) makes the same choice for batched lookups.
     """
-    return table[idx]
+    if table.shape[-1] > 1024:
+        return table[idx]
+    return _onehot_select(table, idx)
 
 
 @table_lookup.def_vmap
@@ -153,6 +169,68 @@ def _default_router(best: SplitDecision, node_of_row, binned):
 
 def _default_feat_sampler(key, rate, binned):
     return _sample_features(key, binned.shape[1], rate)
+
+
+def _subtracted_level_hist(binned, gh_used, pos, n_node: int, cfg,
+                           red, hist_parent):
+    """Level histogram via subtraction + row compaction.
+
+    Per parent, only the child with FEWER rows is built; the sibling is
+    ``parent - small``.  The built rows are compacted into a static
+    N/2-row buffer so the histogram kernel touches ~half the rows per
+    level (sum over parents of min(left, right) <= N/2).  Distributed:
+    the small-child choice comes from psum'd counts, so every shard
+    builds the same children; a shard whose LOCAL small-child rows
+    overflow the buffer flips ALL shards to the plain full build
+    (lax.cond on a psum'd flag — collective-safe).
+    """
+    from xgboost_tpu.ops.histogram import node_stats
+
+    N, F = binned.shape
+    B = cfg.n_bin
+    # per-child ACTIVE-row counts (global under `red`): hessians can
+    # mislead on weighted data and the N/2 capacity bound is on rows
+    ones2 = jnp.broadcast_to(
+        (pos >= 0)[:, None].astype(jnp.float32), (N, 2))
+    counts = red(node_stats(ones2, pos, n_node))[:, 0]       # (n_node,)
+    small_is_left = counts[0::2] <= counts[1::2]
+    is_small = jnp.stack(
+        [small_is_left, ~small_is_left], axis=1).reshape(-1)  # (n_node,)
+
+    msk = (pos >= 0) & table_lookup(is_small, jnp.clip(pos, 0, n_node - 1))
+    cap = max(256, -(-(N // 2) // 256) * 256)
+    dest = jnp.where(msk, jnp.cumsum(msk.astype(jnp.int32)) - 1, cap)
+
+    def subtract_build():
+        b_small = jnp.zeros((cap, F), binned.dtype).at[dest].set(
+            binned, mode="drop")
+        gh_small = jnp.zeros((cap, 2), gh_used.dtype).at[dest].set(
+            gh_used, mode="drop")
+        pos_small = jnp.full(cap, -1, jnp.int32).at[dest].set(
+            pos, mode="drop")
+        from xgboost_tpu.ops.histogram import build_level_histogram
+        hist_small = red(build_level_histogram(
+            b_small, gh_small, pos_small, n_node, B, cfg.hist_precision))
+        # the small child's histogram per parent is the pair-sum (the
+        # non-built sibling's slots are zero)
+        small_of_parent = hist_small.reshape(
+            n_node // 2, 2, F, B, 2).sum(axis=1)
+        sibling = hist_parent - small_of_parent              # (P, F, B, 2)
+        sib_child = jnp.repeat(sibling, 2, axis=0)
+        return jnp.where(is_small[:, None, None, None],
+                         hist_small, sib_child)
+
+    def full_build():
+        from xgboost_tpu.ops.histogram import build_level_histogram
+        return red(build_level_histogram(binned, gh_used, pos, n_node, B,
+                                         cfg.hist_precision))
+
+    # the N/2 bound holds for GLOBAL counts; a skewed shard can still
+    # overflow its local buffer, so reduce the local overflow flag and
+    # (rarely) flip every shard to the plain build together
+    local_over = jnp.sum(msk.astype(jnp.int32)) > cap
+    any_over = red(local_over.astype(jnp.float32)[None])[0] > 0
+    return jax.lax.cond(any_over, full_build, subtract_build)
 
 
 def root_level(n_roots: int) -> int:
@@ -232,20 +310,41 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
     if row_valid is not None:
         pos = jnp.where(row_valid, pos, -1)
     row_leaf = jnp.zeros(N, jnp.int32)
+    hist_prev = None
+    prev = None  # (best, nst, do_split) of the previous level
 
     for depth in range(d0, d0 + D + 1):
         n_node = 1 << depth
         base = n_node - 1  # global index of first node at this level
 
         if depth == d0 + D:
-            # terminal level: everything still active becomes a leaf
-            nst = red(node_stats(gh_used, pos, n_node))  # (n_node, 2)
+            # terminal level: everything still active becomes a leaf.
+            # Node stats DERIVE from the parent's chosen split (left
+            # child = winner's left sums, right = parent - left) when
+            # the finder provides them — a full node_stats pass over
+            # the rows costs ~4.4 ms at 1M rows (v5e, round 3)
+            if prev is not None and prev[0].left_g is not None:
+                p_best, p_nst, p_split = prev
+                gl = jnp.where(p_split, p_best.left_g, 0.0)
+                hl = jnp.where(p_split, p_best.left_h, 0.0)
+                gr = jnp.where(p_split, p_nst[:, 0] - p_best.left_g, 0.0)
+                hr = jnp.where(p_split, p_nst[:, 1] - p_best.left_h, 0.0)
+                nst = jnp.stack(
+                    [jnp.stack([gl, gr], 1).reshape(-1),
+                     jnp.stack([hl, hr], 1).reshape(-1)], axis=1)
+            else:
+                nst = red(node_stats(gh_used, pos, n_node))  # (n_node, 2)
             make_leaf = jnp.ones(n_node, jnp.bool_)
             best = None
         else:
-            hist = red(build_level_histogram(binned, gh_used, pos,
-                                             n_node, cfg.n_bin,
-                                             cfg.hist_precision))
+            if cfg.hist_subtraction and hist_prev is not None:
+                hist = _subtracted_level_hist(binned, gh_used, pos,
+                                              n_node, cfg, red, hist_prev)
+            else:
+                hist = red(build_level_histogram(binned, gh_used, pos,
+                                                 n_node, cfg.n_bin,
+                                                 cfg.hist_precision))
+            hist_prev = hist if cfg.hist_subtraction else None
             # node totals fall out of the histogram (bin sums of any one
             # feature) — saves a per-level pass over all rows
             nst = stats_from_histogram(hist)
@@ -260,6 +359,7 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
             can_try = nst[:, 1] >= 2.0 * cfg.split.min_child_weight
             do_split = best.valid & can_try
             make_leaf = ~do_split
+            prev = (best, nst, do_split)
 
         tree = apply_level(tree, depth, nst, best, make_leaf, cfg.split)
 
@@ -360,11 +460,11 @@ def _traverse_one(tree: TreeArrays, binned: jax.Array, max_depth: int,
         if root is not None:
             node = node + jnp.clip(root.astype(jnp.int32), 0, n_roots - 1)
     for _ in range(max_depth):
-        f = tree.feature[node]
-        leaf = tree.is_leaf[node] | (f < 0)
+        f = table_lookup(tree.feature, node)
+        leaf = table_lookup(tree.is_leaf, node) | (f < 0)
         b = bin_of_feature(binned, jnp.maximum(f, 0))
-        go_left = jnp.where(b == 0, tree.default_left[node],
-                            b <= tree.cut_index[node] + 1)
+        go_left = jnp.where(b == 0, table_lookup(tree.default_left, node),
+                            b <= table_lookup(tree.cut_index, node) + 1)
         nxt = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
         node = jnp.where(leaf, node, nxt)
     return node
@@ -387,7 +487,7 @@ def predict_margin_binned(stack: TreeArrays, tree_group: jax.Array,
     def body(margin, tg):
         tree, group = tg
         leaf = _traverse_one(tree, binned, max_depth, root, n_roots)
-        contrib = tree.leaf_value[leaf]
+        contrib = table_lookup(tree.leaf_value, leaf)
         margin = margin + contrib[:, None] * jax.nn.one_hot(
             group, n_group, dtype=margin.dtype)
         return margin, None

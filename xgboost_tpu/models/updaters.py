@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from xgboost_tpu.models.tree import TreeArrays, bin_of_feature, root_level
+from xgboost_tpu.models.tree import (TreeArrays, bin_of_feature,
+                                     root_level, table_lookup)
 from xgboost_tpu.ops.split import SplitConfig, calc_gain, calc_weight
 
 KNOWN_UPDATERS = ("grow_colmaker", "grow_histmaker", "grow_skmaker",
@@ -137,11 +138,11 @@ def refresh_tree(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
     acc = jnp.zeros((n_nodes, 2), jnp.float32)
     for _ in range(max_depth + 1):
         acc = acc.at[node].add(gh_used)
-        f = tree.feature[node]
-        leaf = tree.is_leaf[node] | (f < 0)
+        f = table_lookup(tree.feature, node)
+        leaf = table_lookup(tree.is_leaf, node) | (f < 0)
         b = bin_of_feature(binned, jnp.maximum(f, 0))
-        go_left = jnp.where(b == 0, tree.default_left[node],
-                            b <= tree.cut_index[node] + 1)
+        go_left = jnp.where(b == 0, table_lookup(tree.default_left, node),
+                            b <= table_lookup(tree.cut_index, node) + 1)
         node = jnp.where(leaf, node, jnp.where(go_left, 2 * node + 1,
                                                2 * node + 2))
         # a row parked at a leaf has contributed at every path node

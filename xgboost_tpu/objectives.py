@@ -54,12 +54,14 @@ class Objective:
     def prob_to_margin(self, base_score: float) -> float:
         return base_score
 
-    def fused_grad(self):
+    def fused_grad(self, info=None):
         """A pure ``(margin, label, weight, iteration) -> (N, K, 2)``
         gradient for the fused multi-round scan (GBTree.do_boost_fused),
-        or None when the objective needs host-side work per round (rank
-        pair sampling, custom objectives).  Must return a STABLE function
-        identity per hyperparameter setting so the scan's jit cache hits
+        or None when the objective needs host-side work per round
+        (custom objectives, host-impl rank).  ``info`` lets objectives
+        with static per-dataset structure (device LambdaRank's group
+        tables) close over it.  Must return a STABLE function identity
+        per (hyperparameters, dataset) so the scan's jit cache hits
         across boosters."""
         return None
 
@@ -144,7 +146,7 @@ class RegLossObj(Objective):
             return -np.log(1.0 / base_score - 1.0)
         return base_score
 
-    def fused_grad(self):
+    def fused_grad(self, info=None):
         return _regloss_fused(self.loss, float(self.scale_pos_weight))
 
 
@@ -200,7 +202,7 @@ class SoftmaxMultiClassObj(Objective):
     def eval_transform(self, margin):
         return jax.nn.softmax(margin, axis=1)
 
-    def fused_grad(self):
+    def fused_grad(self, info=None):
         return _softmax_fused
 
 

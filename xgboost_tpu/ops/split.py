@@ -72,6 +72,11 @@ class BestSplit(NamedTuple):
     cut_index: jax.Array     # (n_node,) int32  (left iff bin <= cut_index+1)
     default_left: jax.Array  # (n_node,) bool
     valid: jax.Array         # (n_node,) bool — accept split?
+    # chosen split's left-child sums (incl. the default-direction missing
+    # mass): lets the grower DERIVE the next level's node stats instead
+    # of a full node_stats pass over the rows (right child = node - left)
+    left_g: jax.Array = None  # (n_node,) f32
+    left_h: jax.Array = None  # (n_node,) f32
 
 
 def find_best_splits(hist: jax.Array, nstats: jax.Array, n_cuts: jax.Array,
@@ -130,4 +135,10 @@ def find_best_splits(hist: jax.Array, nstats: jax.Array, n_cuts: jax.Array,
     # (updater_prune-inl.hpp:42-72), which keeps a weak split whose
     # descendants are strong — pre-pruning would not.
     valid = best_gain > RT_EPS
-    return BestSplit(best_gain, feature, cut_index, default_left, valid)
+    # winner's left-child sums, gather-free (one-hot contraction over the
+    # flat candidate axis — batched gathers serialize on TPU)
+    sel = jax.nn.one_hot(best, flat.shape[1], dtype=jnp.float32)
+    left_g = (GL.reshape(n_node, -1) * sel).sum(axis=1)
+    left_h = (HL.reshape(n_node, -1) * sel).sum(axis=1)
+    return BestSplit(best_gain, feature, cut_index, default_left, valid,
+                     left_g, left_h)

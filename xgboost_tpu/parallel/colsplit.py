@@ -33,7 +33,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from xgboost_tpu.models.tree import (GrowConfig, SplitDecision,
                                      _sample_features, bin_of_feature,
-                                     grow_tree)
+                                     grow_tree,
+                                     table_lookup)
 from xgboost_tpu.ops.split import NEG, RT_EPS, find_best_splits
 
 FEAT_AXIS = "feat"
@@ -88,7 +89,8 @@ def _colsplit_fn(mesh: Mesh, cfg: GrowConfig, f_local: int, n_shard: int,
             key, binned, gh, cut_values, n_cuts, cfg, row_valid,
             split_finder=split_finder, router=router,
             feat_sampler=feat_sampler)
-        delta = tree.leaf_value[row_leaf] * row_valid.astype(jnp.float32)
+        delta = (table_lookup(tree.leaf_value, row_leaf)
+                 * row_valid.astype(jnp.float32))
         return tree, row_leaf, delta
 
     # check_vma=False: every shard derives the SAME tree/row outputs from

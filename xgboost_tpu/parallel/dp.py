@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from xgboost_tpu.models.tree import GrowConfig, grow_tree
+from xgboost_tpu.models.tree import (GrowConfig, grow_tree,
+                                     table_lookup)
 from xgboost_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -42,7 +43,7 @@ def grow_tree_dp(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
                                    split_finder=split_finder,
                                    root=root if cfg.n_roots > 1 else None)
         # leaf-value gather stays inside the shard: indices are shard-local
-        return tree, row_leaf, tree.leaf_value[row_leaf]
+        return tree, row_leaf, table_lookup(tree.leaf_value, row_leaf)
 
     if root is None:
         root = jnp.zeros(binned.shape[0], jnp.int32)

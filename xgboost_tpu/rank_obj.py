@@ -25,9 +25,6 @@ _EPS = 1e-16
 
 
 class LambdaRankObj(Objective):
-    # pair sampling reads the full margin on the host each round
-    needs_host_margin = True
-
     default_metric = "map"
 
     def __init__(self, name: str):
@@ -35,16 +32,84 @@ class LambdaRankObj(Objective):
         self.kind = name.split(":")[1]  # pairwise | ndcg | map
         self.num_pairsample = 1
         self.fix_list_weight = 0.0
+        # "device": pair sampling + delta weights fully on device
+        # (rank_device.py — no per-round host transfer, fused-scan
+        # eligible); "host": the reference-faithful numpy path below
+        self.rank_impl = "device"
         if self.kind == "ndcg":
             self.default_metric = "ndcg"
+
+    @property
+    def needs_host_margin(self) -> bool:
+        # host pair sampling reads the full margin each round
+        return self.rank_impl == "host"
 
     def set_param(self, name, value):
         if name == "num_pairsample":
             self.num_pairsample = int(value)
         elif name == "fix_list_weight":
             self.fix_list_weight = float(value)
+        elif name == "rank_impl":
+            if value not in ("device", "host"):
+                raise ValueError("rank_impl must be 'device' or 'host'")
+            self.rank_impl = value
+
+    # ------------------------------------------------------ device path
+    @staticmethod
+    def _prep(info, n_pad: int):
+        """Static per-dataset structures, cached ON THE INFO (shared by
+        every Booster training on this matrix; cleared by set_field)."""
+        from xgboost_tpu.rank_device import build_prep
+        key = ("rank_prep", n_pad)
+        if key not in info._dev_cache:
+            labels = np.asarray(info.label)
+            gptr = (np.asarray(info.group_ptr) if info.group_ptr is not None
+                    else np.array([0, len(labels)], np.int64))
+            info._dev_cache[key] = build_prep(labels, gptr, n_pad)
+        return info._dev_cache[key]
+
+    def _device_gradient(self, margin, info, iteration, n_rows):
+        import jax
+        import jax.numpy as jnp
+        from xgboost_tpu.rank_device import rank_gradient
+        prep = self._prep(info, n_rows)
+        key = jax.random.fold_in(jax.random.PRNGKey(4177), iteration)
+        gh = rank_gradient(jnp.asarray(margin)[:, 0], key, prep, self.kind,
+                           self.num_pairsample, float(self.fix_list_weight))
+        return gh[:, None, :]
+
+    def fused_grad(self, info=None):
+        """Device rank gradients are pure in (margin, iteration) given
+        the static per-dataset prep — fused-scan eligible.  The closure
+        is cached ON THE INFO: its identity is a jit static argument of
+        the fused scan, and a per-Booster closure would force a full
+        ~60 s re-trace for every new Booster on the same data."""
+        if self.rank_impl != "device" or info is None:
+            return None
+        import jax
+        from xgboost_tpu.rank_device import rank_gradient
+        kind = self.kind
+        nps = self.num_pairsample
+        flw = float(self.fix_list_weight)
+        key_tag = ("rank_fused", kind, nps, flw)
+        if key_tag in info._dev_cache:
+            return info._dev_cache[key_tag]
+        prep_fn = self._prep
+
+        def f(margin, label, weight, iteration):
+            # prep is built host-side at TRACE time (margin.shape is
+            # static there) and enters the jaxpr as constants
+            prep = prep_fn(info, margin.shape[0])
+            key = jax.random.fold_in(jax.random.PRNGKey(4177), iteration)
+            gh = rank_gradient(margin[:, 0], key, prep, kind, nps, flw)
+            return gh[:, None, :]
+
+        info._dev_cache[key_tag] = f
+        return f
 
     def get_gradient(self, margin, info, iteration, n_rows):
+        if self.rank_impl == "device":
+            return self._device_gradient(margin, info, iteration, n_rows)
         import jax.numpy as jnp
         preds = np.asarray(margin)[:, 0]
         labels = np.asarray(info.label)
