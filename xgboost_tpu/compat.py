@@ -215,6 +215,137 @@ def load_reference_model(src):
     return bst
 
 
+# ------------------------------------------------------------------ writer
+
+def _write_str(out: list, s: str) -> None:
+    out.append(struct.pack("<Q", len(s)))
+    out.append(s.encode())
+
+
+def _tree_to_reference(tree, n_roots: int = 1):
+    """Convert one perfect-layout tree to reference (nodes, stats) arrays.
+
+    Allocation order: roots first (ids 0..R-1, TreeModel::InitModel),
+    then children in BFS order (AddChilds appends pairs) — any
+    parent/cleft/cright topology parses, but BFS keeps ids compact.
+    """
+    feature = np.asarray(tree.feature)
+    threshold = np.asarray(tree.threshold)
+    default_left = np.asarray(tree.default_left)
+    is_leaf = np.asarray(tree.is_leaf)
+    leaf_value = np.asarray(tree.leaf_value)
+    gain = np.asarray(tree.gain)
+    sum_hess = np.asarray(tree.sum_hess)
+
+    from xgboost_tpu.models.tree import root_level
+    first = (1 << root_level(n_roots)) - 1
+    roots = list(range(first, first + n_roots))
+
+    def is_split(slot: int) -> bool:
+        return (not is_leaf[slot]) and feature[slot] >= 0
+
+    # breadth-first id assignment over REACHABLE slots
+    ids = {}
+    order = []
+    queue = list(roots)
+    while queue:
+        slot = queue.pop(0)
+        ids[slot] = len(order)
+        order.append(slot)
+        if is_split(slot):
+            queue.append(2 * slot + 1)
+            queue.append(2 * slot + 2)
+
+    n = len(order)
+    nodes = np.zeros(n, _NODE_DT)
+    stats = np.zeros(n, _STAT_DT)
+    depth_max = 0
+    for slot in order:
+        nid = ids[slot]
+        stats["sum_hess"][nid] = sum_hess[slot]
+        stats["base_weight"][nid] = leaf_value[slot]
+        if is_split(slot):
+            left, right = ids[2 * slot + 1], ids[2 * slot + 2]
+            nodes["cleft"][nid] = left
+            nodes["cright"][nid] = right
+            # parent packs the is-left-child bit in the sign bit
+            # (model.h set_parent)
+            nodes["parent"][left] = np.uint32(nid | (1 << 31)).view(np.int32)
+            nodes["parent"][right] = nid
+            nodes["sindex"][nid] = (np.uint32(feature[slot])
+                                    | (np.uint32(1) << 31
+                                       if default_left[slot]
+                                       else np.uint32(0)))
+            nodes["info"][nid] = threshold[slot]
+            stats["loss_chg"][nid] = gain[slot]
+        else:
+            nodes["cleft"][nid] = -1
+            nodes["cright"][nid] = -1
+            nodes["info"][nid] = leaf_value[slot]
+    for r in roots:
+        nodes["parent"][ids[r]] = -1
+    return nodes, stats
+
+
+def save_reference_model(booster, path: Optional[str] = None,
+                         base64_mode: bool = False) -> bytes:
+    """Serialize a Booster into the reference's binary model format, so
+    reference tooling (CLI ``task=pred``/``dump``, the C API, the R
+    package) can consume models trained here — the write half of this
+    module (reference SaveModel: ``learner-inl.hpp:209-252``,
+    ``gbtree-inl.hpp:42-78``, ``model.h:320-330``).
+
+    Returns the bytes; also writes them to ``path`` when given.
+    ``base64_mode`` emits the text-safe ``bs64`` encoding.
+    """
+    assert booster.gbtree is not None, "nothing to save"
+    obj = booster.obj
+    if obj is None:
+        booster._init_obj()
+        obj = booster.obj
+    out: list = []
+    base_margin = float(obj.prob_to_margin(booster.param.base_score))
+    num_class = int(booster.param.num_class)
+    nf = int(booster.num_feature)
+    out.append(_LEARNER_PARAM.pack(base_margin, nf, num_class))
+    _write_str(out, booster.param.objective)
+    gbm = "gblinear" if booster.param.booster == "gblinear" else "gbtree"
+    _write_str(out, gbm)
+
+    if gbm == "gblinear":
+        w = np.asarray(booster.gbtree.weight, np.float32)
+        b = np.asarray(booster.gbtree.bias, np.float32)[None, :]
+        K = w.shape[1]
+        out.append(_GBLINEAR_PARAM.pack(nf, K))
+        flat = np.concatenate([w, b]).astype("<f4")  # bias LAST
+        out.append(struct.pack("<Q", flat.size))
+        out.append(flat.tobytes())
+    else:
+        gbt = booster.gbtree
+        n_roots = max(1, booster.param.num_roots)
+        trees = gbt.trees
+        K = max(1, booster.param.num_output_group)
+        out.append(_GBTREE_PARAM.pack(len(trees), n_roots, nf, 0,
+                                      K if K > 1 else 1, 0))
+        for t in trees:
+            nodes, stats = _tree_to_reference(t, n_roots)
+            out.append(_TREE_PARAM.pack(n_roots, len(nodes), 0,
+                                        int(booster.param.max_depth), nf, 0))
+            out.append(nodes.tobytes())
+            out.append(stats.tobytes())
+        out.append(np.asarray(gbt.tree_group, "<i4").tobytes())
+
+    payload = b"".join(out)
+    if base64_mode:
+        data = b"bs64\t" + base64.b64encode(payload) + b"\n"
+    else:
+        data = b"binf" + payload
+    if path is not None:
+        with open(path, "wb") as f:
+            f.write(data)
+    return data
+
+
 def _margin_to_base_score(obj, margin: float) -> float:
     """Invert prob_to_margin: the reference stores base_score already
     margin-transformed (learner-inl.hpp:151)."""

@@ -146,17 +146,30 @@ class Booster:
                 elif getattr(dtrain, "is_external", False):
                     # streaming sketch over raw pages (SURVEY.md §5.7);
                     # paged matrices always use the histogram method, as
-                    # in the reference (learner-inl.hpp:263-267)
+                    # in the reference (learner-inl.hpp:263-267) — even
+                    # for updater=grow_colmaker (exact_raw is cleared
+                    # below: paged training is binned end to end)
                     cuts = dtrain.sketch_cuts(self.param.max_bin,
                                               self.param.sketch_eps,
                                               self.param.sketch_ratio)
-                elif "grow_colmaker" in parse_updaters(self.param.updater):
-                    # exact greedy: cuts at every distinct value (under
-                    # dsplit=col this is the distributed exact mode — the
-                    # reference's DistColMaker extends ColMaker)
+                elif ("grow_colmaker" in parse_updaters(self.param.updater)
+                        and self.param.dsplit in ("row", "col")):
+                    # distributed exact: cuts at every distinct value up
+                    # to max_exact_bin (under dsplit=col this is the
+                    # DistColMaker mode; under dsplit=row the reference
+                    # itself switches away from exact,
+                    # learner-inl.hpp:91-93)
                     from xgboost_tpu.binning import compute_cuts_exact
                     cuts = compute_cuts_exact(dtrain,
                                               self.param.max_exact_bin)
+                elif "grow_colmaker" in parse_updaters(self.param.updater):
+                    # TRUE exact-greedy (models/colmaker.py): bin-free —
+                    # sorted raw-value scans at ANY cardinality; the
+                    # CutMatrix is a placeholder (nothing is quantized)
+                    from xgboost_tpu.binning import CutMatrix
+                    cuts = CutMatrix(
+                        np.full((dtrain.num_col, 1), np.inf, np.float32),
+                        np.zeros(dtrain.num_col, np.int32))
                 elif self.param.dsplit == "row" and (
                         self.param.device_sketch > 0
                         or (self.param.device_sketch < 0
@@ -179,6 +192,10 @@ class Booster:
                                         self.param.sketch_eps,
                                         self.param.sketch_ratio)
                 self.gbtree = GBTree(self.param, cuts)
+                if getattr(dtrain, "is_external", False):
+                    # paged matrices route through the binned pipeline
+                    # regardless of updater (see the sketch branch above)
+                    self.gbtree.exact_raw = False
         if getattr(dtrain, "is_sharded", False) and self._mesh is None:
             # continued training (loaded model) on a split-loaded matrix:
             # mesh resolution belongs HERE, not in the entry builder
@@ -253,6 +270,14 @@ class Booster:
                         dmat, binned, self._base_margin_of(dmat, dmat.num_row))
             elif self._mesh is not None:
                 self._cache[key] = self._make_sharded_entry(dmat)
+            elif getattr(self.gbtree, "exact_raw", False):
+                # exact mode is bin-free: entries hold RAW values (NaN =
+                # missing); trees route by value comparison
+                entry = _CacheEntry(
+                    dmat, self._raw_dense(dmat),
+                    self._base_margin_of(dmat, dmat.num_row))
+                entry.exact_data = None  # built lazily for TRAIN matrices
+                self._cache[key] = entry
             else:
                 binned = jnp.asarray(bin_matrix(dmat, self.gbtree.cuts))
                 if self._col_mesh is not None:
@@ -268,10 +293,15 @@ class Booster:
         if (entry.info is dmat.info
                 and entry.info_version != dmat.info.version):
             # plain entries SHARE the MetaInfo: label/weight freshness
-            # rides info._dev_cache invalidation, but entry.root is an
-            # entry-level snapshot — refresh it on any set_field
+            # rides info._dev_cache invalidation, but root and base
+            # margin are entry-level snapshots — refresh them (and the
+            # margin built on base) on any set_field
             entry.root = None
             self._attach_root(entry, dmat)
+            if not entry.external:
+                entry.base = self._base_margin_of(dmat, dmat.num_row)
+            entry.margin = None
+            entry.applied = 0
             entry.info_version = dmat.info.version
         return entry
 
@@ -416,6 +446,15 @@ class Booster:
         entry = _CacheEntry(dmat, binned, base, info=info,
                             row_valid=row_valid, n_real=dmat.global_num_row)
         return entry
+
+    def _raw_dense(self, dmat) -> jax.Array:
+        """Dense raw-value device matrix for exact mode (NaN = missing),
+        feature-padded/truncated to the model width."""
+        X = dmat.to_dense(missing=np.nan)
+        if X.shape[1] < self.num_feature:
+            X = np.pad(X, ((0, 0), (0, self.num_feature - X.shape[1])),
+                       constant_values=np.nan)
+        return jnp.asarray(X[:, :self.num_feature])
 
     def _replicated(self, x):
         """Make a device value fully addressable for host pulls: in
@@ -583,6 +622,7 @@ class Booster:
             and self.profiler is None
             and not (self.param.gamma > 0.0 and "prune" in ups)
             and max(1, self.param.num_roots) == 1
+            and not getattr(self.gbtree, "exact_raw", False)
             and "refresh" not in ups
             and any(u.startswith("grow") for u in ups)
             and self.obj.fused_grad(entry.info) is not None)
@@ -653,6 +693,14 @@ class Booster:
             entry.applied = self.gbtree.num_trees
             return
         grows = any(u.startswith("grow") or u == "distcol" for u in ups)
+        if grows and getattr(self.gbtree, "exact_raw", False):
+            # install this matrix's static sort structures (one-off)
+            if getattr(entry, "exact_data", None) is None:
+                from xgboost_tpu.models.colmaker import build_exact_data
+                vs, od, nf = build_exact_data(np.asarray(entry.binned))
+                entry.exact_data = (jnp.asarray(vs), jnp.asarray(od),
+                                    jnp.asarray(nf))
+            self.gbtree.set_exact_data(*entry.exact_data)
         if grows:
             _, delta = self.gbtree.do_boost(entry.binned, gh, key,
                                             row_valid=entry.row_valid,
@@ -751,6 +799,9 @@ class Booster:
                     f"with {self.num_feature}")
             if self.param.booster == "gblinear":
                 binned = self.gbtree.device_matrix(data)
+            elif getattr(self.gbtree, "exact_raw", False):
+                # exact mode routes on RAW values (no bins exist)
+                binned = self._raw_dense(data)
             else:
                 binned = jnp.asarray(bin_matrix(data, self.gbtree.cuts))
             base = self._base_margin_of(data, data.num_row)

@@ -139,6 +139,12 @@ class GBTree:
         self.param = param
         self.cuts = cuts
         self.cfg = make_grow_config(param, cuts.max_bin)
+        # TRUE exact-greedy mode (models/colmaker.py): bin-free raw-value
+        # pipeline, single-controller (the reference's distributed modes
+        # are histmaker for dsplit=row, DistColMaker for dsplit=col)
+        from xgboost_tpu.models.updaters import parse_updaters
+        self.exact_raw = ("grow_colmaker" in parse_updaters(param.updater)
+                          and param.dsplit not in ("row", "col"))
         self._split_finder_cache = None  # stable identity (jit static arg)
         self._trees_list: List[TreeArrays] = []  # materialized per-tree pytrees
         # stacked trees not yet sliced into _trees_list (fused rounds /
@@ -242,6 +248,9 @@ class GBTree:
             raise NotImplementedError(
                 "root_index needs num_roots > 1 (and dsplit != col): set "
                 "num_roots to the number of tree roots")
+        if self.exact_raw:
+            return self._do_boost_exact(binned, gh, key, row_valid,
+                                        do_prune, K, npar)
         if (col_mesh is None and K * npar > 1
                 and not os.environ.get("XGBTPU_SEQ_BOOST")):
             return self._do_boost_vmapped(binned, gh, key, row_valid, mesh,
@@ -294,6 +303,49 @@ class GBTree:
                     # padding rows land on node 0, which carries the root's
                     # would-be leaf weight; zero their delta so their cached
                     # margin stays at the entry's (zero-padded) base value
+                    d = d * row_valid.astype(d.dtype)
+                new_trees.append(tree)
+                self.trees.append(tree)
+                self.tree_group.append(k)
+                delta_k = d if delta_k is None else delta_k + d
+            deltas.append(delta_k)
+        self._stack_cache = None
+        return new_trees, jnp.stack(deltas, axis=1)
+
+    def set_exact_data(self, vals_sorted, order, n_finite) -> None:
+        """Install the training matrix's static sort structures (built by
+        the learner entry; colmaker.build_exact_data)."""
+        self._exact_data = (vals_sorted, order, n_finite)
+
+    def _do_boost_exact(self, X, gh, key, row_valid, do_prune: bool,
+                        K: int, npar: int):
+        """Exact-greedy round: sequential per-tree growth (the exact
+        scans don't share a one-hot, so there is nothing to batch)."""
+        from xgboost_tpu.models.colmaker import grow_tree_exact
+        from xgboost_tpu.models.updaters import prune_tree
+        from xgboost_tpu.parallel import mock
+        assert getattr(self, "_exact_data", None) is not None, \
+            "exact mode: set_exact_data was not called for this matrix"
+        vs, od, nf = self._exact_data
+        if self.cfg.n_roots > 1:
+            raise NotImplementedError(
+                "num_roots > 1 is not supported by the exact grower")
+        new_trees: List[TreeArrays] = []
+        deltas = []
+        for k in range(K):
+            delta_k = None
+            for t in range(npar):
+                mock.collective()
+                tkey = jax.random.fold_in(key, k * npar + t)
+                tree, row_leaf = grow_tree_exact(
+                    tkey, X, vs, od, nf, gh[:, k, :], self.cfg, row_valid)
+                if do_prune:
+                    tree, resolve = prune_tree(tree, self.param.gamma)
+                    d = table_lookup(tree.leaf_value[jnp.asarray(resolve)],
+                                     row_leaf)
+                else:
+                    d = table_lookup(tree.leaf_value, row_leaf)
+                if row_valid is not None:
                     d = d * row_valid.astype(d.dtype)
                 new_trees.append(tree)
                 self.trees.append(tree)
@@ -544,9 +596,13 @@ class GBTree:
                        ntree_limit: int = 0,
                        root: Optional[jax.Array] = None) -> jax.Array:
         stack, group = self._stack(ntree_limit)
+        K = max(1, self.param.num_output_group)
+        if self.exact_raw:
+            from xgboost_tpu.models.colmaker import predict_margin_raw
+            return predict_margin_raw(stack, group, binned, base,
+                                      self.cfg.max_depth, K)
         return predict_margin_binned(
-            stack, group, binned, base, self.cfg.max_depth,
-            max(1, self.param.num_output_group),
+            stack, group, binned, base, self.cfg.max_depth, K,
             root=root, n_roots=self.cfg.n_roots)
 
     def predict_incremental(self, binned: jax.Array, margin: jax.Array,
@@ -561,6 +617,11 @@ class GBTree:
         group = jnp.asarray(
             [first_group + i // npar for i in range(len(new_trees))],
             dtype=jnp.int32)
+        if self.exact_raw:
+            from xgboost_tpu.models.colmaker import predict_margin_raw
+            return predict_margin_raw(
+                stack, group, binned, jnp.zeros((), jnp.float32),
+                self.cfg.max_depth, K) + margin
         return predict_margin_binned(
             stack, group, binned, jnp.zeros((), jnp.float32),
             self.cfg.max_depth, K,
@@ -569,6 +630,13 @@ class GBTree:
     def predict_leaf(self, binned: jax.Array, ntree_limit: int = 0,
                      root: Optional[jax.Array] = None) -> jax.Array:
         stack, _ = self._stack(ntree_limit)
+        if self.exact_raw:
+            from xgboost_tpu.models.colmaker import traverse_raw
+
+            def body(_, tree):
+                return None, traverse_raw(tree, binned, self.cfg.max_depth)
+            _, leaves = jax.lax.scan(body, None, stack)
+            return leaves.T
         return predict_leaf_binned(stack, binned, self.cfg.max_depth,
                                    root=root, n_roots=self.cfg.n_roots)
 

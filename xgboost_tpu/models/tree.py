@@ -172,7 +172,7 @@ def _default_feat_sampler(key, rate, binned):
 
 
 def _subtracted_level_hist(binned, gh_used, pos, n_node: int, cfg,
-                           red, hist_parent):
+                           red, hist_parent, parent_split):
     """Level histogram via subtraction + row compaction.
 
     Per parent, only the child with FEWER rows is built; the sibling is
@@ -215,7 +215,11 @@ def _subtracted_level_hist(binned, gh_used, pos, n_node: int, cfg,
         # non-built sibling's slots are zero)
         small_of_parent = hist_small.reshape(
             n_node // 2, 2, F, B, 2).sum(axis=1)
-        sibling = hist_parent - small_of_parent              # (P, F, B, 2)
+        # children of NON-split (leaf) parents have no rows: without the
+        # mask, sibling = parent - 0 would hand the parent's full mass
+        # to a phantom node, diverging from the plain build
+        sibling = jnp.where(parent_split[:, None, None, None],
+                            hist_parent - small_of_parent, 0.0)
         sib_child = jnp.repeat(sibling, 2, axis=0)
         return jnp.where(is_small[:, None, None, None],
                          hist_small, sib_child)
@@ -339,7 +343,8 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
         else:
             if cfg.hist_subtraction and hist_prev is not None:
                 hist = _subtracted_level_hist(binned, gh_used, pos,
-                                              n_node, cfg, red, hist_prev)
+                                              n_node, cfg, red, hist_prev,
+                                              prev[2])
             else:
                 hist = red(build_level_histogram(binned, gh_used, pos,
                                                  n_node, cfg.n_bin,
