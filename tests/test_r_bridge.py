@@ -1,0 +1,50 @@
+"""Static consistency of the R package's Python bridge.
+
+No R runtime exists in this image (COVERAGE.md row 5), so the
+reticulate frontend cannot EXECUTE here; what CAN be verified is that
+every Python attribute/method the R sources call through the bridge
+actually exists with a compatible surface — the failure mode that
+silently breaks reticulate frontends when the core API drifts."""
+
+import os
+import re
+
+import xgboost_tpu as xgb
+
+R_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "R-package", "R")
+
+
+def _r_sources():
+    out = []
+    for name in sorted(os.listdir(R_DIR)):
+        if name.endswith(".R"):
+            with open(os.path.join(R_DIR, name)) as f:
+                out.append((name, f.read()))
+    assert out, "R sources missing"
+    return out
+
+
+def test_core_module_attributes_exist():
+    refs = set()
+    for _, src in _r_sources():
+        refs.update(re.findall(r"\bcore\$([A-Za-z_][A-Za-z_.]*)", src))
+    assert refs, "no core$ references found"
+    for attr in refs:
+        assert hasattr(xgb, attr.split("$")[0]), f"core${attr} missing"
+
+
+def test_booster_and_dmatrix_methods_exist():
+    import numpy as np
+    X = np.random.RandomState(0).rand(50, 3).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 2},
+                    d, 1, verbose_eval=False)
+    methods = set()
+    for _, src in _r_sources():
+        methods.update(re.findall(
+            r"\b(?:bst|handle|dmat)\$([A-Za-z_]+)\(", src))
+    assert methods
+    for m in methods:
+        assert hasattr(bst, m) or hasattr(d, m), f"bridge method {m} missing"

@@ -272,3 +272,40 @@ def test_multi_root_trees_route_by_root_index():
     # dump shows each root's subtree
     dumps = bst.get_dump()
     assert dumps[0].count(":[") >= 2  # at least one split under each root
+
+
+def test_exact_mode_presence_only_and_agaricus_canonical():
+    """Exact mode proposes missing-vs-present splits (the reference's
+    end-of-scan candidates) — the ONLY split on presence-only one-hot
+    columns — and reproduces the reference's canonical exact-greedy
+    agaricus numbers."""
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(0)
+    n = 2000
+    present = rng.rand(n) < 0.5
+    X = np.full((n, 2), np.nan, np.float32)
+    X[present, 0] = 1.0
+    X[:, 1] = rng.rand(n)
+    y = present.astype(np.float32)
+    r = {}
+    xgb.train({"objective": "binary:logistic", "max_depth": 2, "eta": 1.0,
+               "updater": "grow_colmaker,prune"},
+              xgb.DMatrix(X, label=y), 3,
+              evals=[(xgb.DMatrix(X, label=y), "train")], evals_result=r,
+              verbose_eval=False)
+    assert r["train-error"][-1] < 0.01, r
+
+    dtrain = xgb.DMatrix("/root/reference/demo/data/agaricus.txt.train")
+    dtest = xgb.DMatrix("/root/reference/demo/data/agaricus.txt.test",
+                        num_col=dtrain.num_col)
+    r = {}
+    xgb.train({"objective": "binary:logistic", "max_depth": 3, "eta": 1.0,
+               "updater": "grow_colmaker,prune"}, dtrain, 2,
+              evals=[(dtrain, "train"), (dtest, "test")], evals_result=r,
+              verbose_eval=False)
+    # the reference CLI's exact-greedy numbers for this config
+    assert r["train-error"][0] == pytest.approx(0.014433, abs=2e-6)
+    assert r["test-error"][0] == pytest.approx(0.016139, abs=2e-6)
+    assert r["train-error"][1] == pytest.approx(0.001228, abs=2e-6)
+    assert r["test-error"][1] == 0.0

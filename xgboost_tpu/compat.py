@@ -259,7 +259,6 @@ def _tree_to_reference(tree, n_roots: int = 1):
     n = len(order)
     nodes = np.zeros(n, _NODE_DT)
     stats = np.zeros(n, _STAT_DT)
-    depth_max = 0
     for slot in order:
         nid = ids[slot]
         stats["sum_hess"][nid] = sum_hess[slot]
@@ -288,17 +287,28 @@ def _tree_to_reference(tree, n_roots: int = 1):
 
 
 def save_reference_model(booster, path: Optional[str] = None,
-                         base64_mode: bool = False) -> bytes:
+                         base64_mode: bool = False,
+                         num_pbuffer: Optional[int] = None) -> bytes:
     """Serialize a Booster into the reference's binary model format, so
-    reference tooling (CLI ``task=pred``/``dump``, the C API, the R
-    package) can consume models trained here — the write half of this
-    module (reference SaveModel: ``learner-inl.hpp:209-252``,
+    reference tooling (CLI ``task=pred``/``train``/``eval``, the C API,
+    the R package) can consume models trained here — the write half of
+    this module (reference SaveModel: ``learner-inl.hpp:209-252``,
     ``gbtree-inl.hpp:42-78``, ``model.h:320-330``).
+
+    ``num_pbuffer``: prediction-buffer row capacity baked into the model
+    (reference semantics: the row count of the matrices cached at train
+    time; consumers that cache matrices — continued training, eval —
+    abort on a smaller value, gbtree-inl.hpp BufferOffset check).
+    Default: the total rows of this Booster's cached matrices, matching
+    what the reference itself would have written.  A ZEROED buffer is
+    emitted (pred_counter 0 = "no trees applied" — consumers recompute).
 
     Returns the bytes; also writes them to ``path`` when given.
     ``base64_mode`` emits the text-safe ``bs64`` encoding.
     """
     assert booster.gbtree is not None, "nothing to save"
+    if num_pbuffer is None:
+        num_pbuffer = sum(e.n_real for e in booster._cache.values())
     obj = booster.obj
     if obj is None:
         booster._init_obj()
@@ -325,7 +335,8 @@ def save_reference_model(booster, path: Optional[str] = None,
         n_roots = max(1, booster.param.num_roots)
         trees = gbt.trees
         K = max(1, booster.param.num_output_group)
-        out.append(_GBTREE_PARAM.pack(len(trees), n_roots, nf, 0,
+        out.append(_GBTREE_PARAM.pack(len(trees), n_roots, nf,
+                                      int(num_pbuffer),
                                       K if K > 1 else 1, 0))
         for t in trees:
             nodes, stats = _tree_to_reference(t, n_roots)
@@ -334,6 +345,14 @@ def save_reference_model(booster, path: Optional[str] = None,
             out.append(nodes.tobytes())
             out.append(stats.tobytes())
         out.append(np.asarray(gbt.tree_group, "<i4").tobytes())
+        if num_pbuffer:
+            # zeroed pred_buffer (num_pbuffer * PredBufferSize floats;
+            # PredBufferSize = num_output_group with size_leaf_vector=0)
+            # + zeroed pred_counter (uint32) — counter 0 means "no trees
+            # applied", so consumers recompute from scratch
+            out.append(b"\x00" * (4 * int(num_pbuffer)
+                                  * (K if K > 1 else 1)))
+            out.append(b"\x00" * (4 * int(num_pbuffer)))
 
     payload = b"".join(out)
     if base64_mode:

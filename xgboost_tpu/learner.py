@@ -298,7 +298,11 @@ class Booster:
             # margin built on base) on any set_field
             entry.root = None
             self._attach_root(entry, dmat)
-            if not entry.external:
+            if entry.external:
+                # streaming-external entries keep the base HOST-side
+                entry.base = np.asarray(
+                    self._base_margin_of(dmat, dmat.num_row))
+            else:
                 entry.base = self._base_margin_of(dmat, dmat.num_row)
             entry.margin = None
             entry.applied = 0
@@ -330,6 +334,11 @@ class Booster:
 
     def _build_ext_entry(self, dmat) -> _CacheEntry:
         """Entry for an external-memory matrix (not necessarily cached)."""
+        if getattr(self.gbtree, "exact_raw", False):
+            raise NotImplementedError(
+                "exact-mode (grow_colmaker) models route on raw values; "
+                "external-memory matrices are binned — load this matrix "
+                "in memory (DMatrix) for exact-mode predict/eval/train")
         if self._col_mesh is not None:
             raise NotImplementedError(
                 "external-memory matrices do not support dsplit=col "

@@ -221,6 +221,48 @@ def _find_exact_splits(vals_sorted, order, n_finite, gh_used, pos, nst,
                 > (sel * lg_dr).sum(axis=0))
         b_gl = (sel * jnp.where(b_dl[None, :], GL_dl, GL_dr)).sum(axis=0)
         b_hl = (sel * jnp.where(b_dl[None, :], HL_dl, HL_dr)).sum(axis=0)
+
+        # END-OF-SCAN candidates: split PRESENT vs MISSING (the
+        # reference proposes these after each directional scan — the
+        # only possible split on presence-only one-hot columns, where
+        # all finite node values are equal and no boundary exists).
+        # dr: all finite left, missing right (thr just above the node's
+        # max value); dl: missing left, all finite right (thr just
+        # below the min).  mcw filtering kills the empty-side cases.
+        a_max = a_run[-1]                                # (M,) node max
+        a_min = b_rev[0]                                 # (M,) node min
+        has_fin = jnp.isfinite(a_max)
+        eps_hi = jnp.maximum(jnp.abs(a_max) * 1e-6, 1e-6)
+        eps_lo = jnp.maximum(jnp.abs(a_min) * 1e-6, 1e-6)
+
+        def end_gain(GL, HL):
+            GR = G_tot - GL
+            HR = H_tot - HL
+            ok = (has_fin & (HL >= scfg.min_child_weight)
+                  & (HR >= scfg.min_child_weight))
+            lgv = (calc_gain(GL, HL, scfg) + calc_gain(GR, HR, scfg)
+                   - root_gain)
+            return jnp.where(ok, lgv, NEG)
+
+        g_end_dr = end_gain(Gf, Hf)           # present left, missing right
+        g_end_dl = end_gain(Gmiss, Hmiss)     # missing left, present right
+        if scfg.default_direction == 1:
+            g_end_dr = jnp.full_like(g_end_dr, NEG)
+        elif scfg.default_direction == 2:
+            g_end_dl = jnp.full_like(g_end_dl, NEG)
+
+        cand_g = jnp.stack([bg, g_end_dr, g_end_dl])     # (3, M)
+        pick = jnp.argmax(cand_g, axis=0)      # boundary wins ties, dr<dl
+        bg = cand_g.max(axis=0)
+        b_thr = jnp.where(pick == 0, b_thr,
+                          jnp.where(pick == 1,
+                                    jnp.where(has_fin, a_max + eps_hi, 0.0),
+                                    jnp.where(has_fin, a_min - eps_lo, 0.0)))
+        b_dl = jnp.where(pick == 0, b_dl, pick == 2)
+        b_gl = jnp.where(pick == 0, b_gl,
+                         jnp.where(pick == 1, Gf, Gmiss))
+        b_hl = jnp.where(pick == 0, b_hl,
+                         jnp.where(pick == 1, Hf, Hmiss))
         return carry, (bg, b_thr, b_dl, b_gl, b_hl)
 
     _, (gains, thrs, dls, gls, hls) = jax.lax.scan(
