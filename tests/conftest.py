@@ -29,3 +29,14 @@ def mesh8():
     """The 8-virtual-device data-parallel mesh."""
     from xgboost_tpu.parallel.mesh import data_parallel_mesh
     return data_parallel_mesh(8)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Free compiled executables between test MODULES: a single pytest
+    process accumulates every jit executable of ~190 tests, and the XLA
+    CPU compiler has been seen segfaulting late in the run under that
+    memory pressure.  Cross-module cache reuse is minimal (each module
+    compiles its own shapes), so this costs little."""
+    yield
+    jax.clear_caches()

@@ -151,6 +151,18 @@ def test_reference_writer_multiclass_and_linear(tmp_path):
     np.testing.assert_allclose(b2.predict(xgb.DMatrix(X)),
                                bst.predict(xgb.DMatrix(X)),
                                rtol=1e-6, atol=1e-7)
+    # the prediction-buffer sections are num_pbuffer * num_output_group
+    # entries EACH (gbtree-inl.hpp PredBufferSize) — a K=1-shaped counter
+    # would make multiclass models unreadable by reference tooling
+    from xgboost_tpu.compat import _GBTREE_PARAM, _LEARNER_PARAM
+    raw = p[4:]  # skip binf
+    off = _LEARNER_PARAM.size
+    from xgboost_tpu.compat import _read_str
+    _, off = _read_str(raw, off)
+    _, off = _read_str(raw, off)
+    _, _, _, npb, nog, _ = _GBTREE_PARAM.unpack_from(raw, off)
+    assert npb == 300 and nog == 3
+    assert raw.endswith(b"\x00" * (8 * 300 * 3))  # buffer + counter
 
     yl = (X[:, 0] > 0.5).astype(np.float32)
     bl = xgb.train({"booster": "gblinear", "objective": "binary:logistic",

@@ -342,7 +342,7 @@ def test_dp_collectives_in_compiled_program(mesh8):
                            cuts.max_bin)
     gh = np.stack([0.5 - y, np.full(N, 0.25)], 1).astype(np.float32)
 
-    mesh = data_parallel_mesh(8)
+    mesh = mesh8
     args = (jax.random.PRNGKey(0),
             shard_rows(mesh, jnp.asarray(bin_dense(X, cuts))),
             shard_rows(mesh, jnp.asarray(gh)),

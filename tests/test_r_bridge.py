@@ -31,7 +31,8 @@ def test_core_module_attributes_exist():
         refs.update(re.findall(r"\bcore\$([A-Za-z_][A-Za-z_.]*)", src))
     assert refs, "no core$ references found"
     for attr in refs:
-        assert hasattr(xgb, attr.split("$")[0]), f"core${attr} missing"
+        # dotted chains (core$foo.bar) resolve their root attribute
+        assert hasattr(xgb, attr.split(".")[0]), f"core${attr} missing"
 
 
 def test_booster_and_dmatrix_methods_exist():

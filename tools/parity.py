@@ -281,8 +281,18 @@ def write_report(results: dict):
         "throughput.",
         "",
     ]
-    with open(os.path.join(REPO, "PARITY.md"), "w") as f:
-        f.write("\n".join(lines))
+    # preserve appended analysis sections (the attribution sweep from
+    # tools/parity_sweep.py) across regeneration
+    md_path = os.path.join(REPO, "PARITY.md")
+    keep = ""
+    if os.path.exists(md_path):
+        with open(md_path) as f:
+            old = f.read()
+        marker = "\n## Parity attribution sweep"
+        if marker in old:
+            keep = marker + old.split(marker, 1)[1]
+    with open(md_path, "w") as f:
+        f.write("\n".join(lines) + keep)
     print("[parity] wrote PARITY.json + PARITY.md", flush=True)
 
 

@@ -346,13 +346,13 @@ def save_reference_model(booster, path: Optional[str] = None,
             out.append(stats.tobytes())
         out.append(np.asarray(gbt.tree_group, "<i4").tobytes())
         if num_pbuffer:
-            # zeroed pred_buffer (num_pbuffer * PredBufferSize floats;
-            # PredBufferSize = num_output_group with size_leaf_vector=0)
-            # + zeroed pred_counter (uint32) — counter 0 means "no trees
+            # zeroed pred_buffer AND pred_counter, each PredBufferSize =
+            # num_pbuffer * num_output_group entries (gbtree-inl.hpp:58-61
+            # resizes BOTH by PredBufferSize); counter 0 means "no trees
             # applied", so consumers recompute from scratch
-            out.append(b"\x00" * (4 * int(num_pbuffer)
-                                  * (K if K > 1 else 1)))
-            out.append(b"\x00" * (4 * int(num_pbuffer)))
+            n_ent = int(num_pbuffer) * (K if K > 1 else 1)
+            out.append(b"\x00" * (4 * n_ent))
+            out.append(b"\x00" * (4 * n_ent))
 
     payload = b"".join(out)
     if base64_mode:
