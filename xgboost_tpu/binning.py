@@ -20,6 +20,7 @@ Binning scheme:
 from __future__ import annotations
 
 import dataclasses
+import sys
 from typing import Optional
 
 import numpy as np
@@ -75,6 +76,15 @@ def compute_cuts(dmat: DMatrix, max_bin: int = 256, sketch_eps: float = 0.03,
     return pack_cuts(per_feature)
 
 
+def _rank0() -> bool:
+    """Rank-gate library-level warnings (the CLI silences rank != 0)."""
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
 def pack_cuts(per_feature) -> CutMatrix:
     """Pack per-feature cut lists into an inf-padded rectangular CutMatrix."""
     F = len(per_feature)
@@ -101,10 +111,12 @@ def compute_cuts_exact(dmat: DMatrix, max_exact_bin: int = 4096) -> CutMatrix:
     """
     F = dmat.num_col
     per_feature = []
+    n_capped = 0
     for f in range(F):
         _, vals = dmat.column_values(f)
         uniq = np.unique(vals)
         if len(uniq) > max_exact_bin:
+            n_capped += 1
             cuts = propose_cuts(
                 prune_summary(make_summary(vals), 2 * max_exact_bin),
                 max_exact_bin)
@@ -116,6 +128,13 @@ def compute_cuts_exact(dmat: DMatrix, max_exact_bin: int = 4096) -> CutMatrix:
             # features (all-ones columns in libsvm one-hot data)
             cuts = uniq.astype(np.float32)
         per_feature.append(cuts)
+    if n_capped and _rank0():
+        print(f"[grow_colmaker] {n_capped}/{F} features exceed "
+              f"max_exact_bin={max_exact_bin} distinct values and were "
+              "quantized to that many cuts — the distributed column-split "
+              "exact mode is approximate past the cap (single-controller "
+              "training uses the uncapped exact grower instead)",
+              file=sys.stderr)
     return pack_cuts(per_feature)
 
 
