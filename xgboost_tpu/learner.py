@@ -87,6 +87,7 @@ class Booster:
         self.obj.set_param("num_pairsample", self.param.num_pairsample)
         self.obj.set_param("fix_list_weight", self.param.fix_list_weight)
         self.obj.set_param("rank_impl", self.param.rank_impl)
+        self.obj.set_param("seed", self.param.seed)
 
     def _reconfigure(self):
         """Propagate changed params into live objective/booster state, so

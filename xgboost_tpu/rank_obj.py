@@ -36,6 +36,7 @@ class LambdaRankObj(Objective):
         # (rank_device.py — no per-round host transfer, fused-scan
         # eligible); "host": the reference-faithful numpy path below
         self.rank_impl = "device"
+        self.seed = 0  # folds into the pair-sampling PRNGs
         if self.kind == "ndcg":
             self.default_metric = "ndcg"
 
@@ -53,6 +54,8 @@ class LambdaRankObj(Objective):
             if value not in ("device", "host"):
                 raise ValueError("rank_impl must be 'device' or 'host'")
             self.rank_impl = value
+        elif name == "seed":
+            self.seed = int(value)
 
     # ------------------------------------------------------ device path
     @staticmethod
@@ -73,7 +76,8 @@ class LambdaRankObj(Objective):
         import jax.numpy as jnp
         from xgboost_tpu.rank_device import rank_gradient
         prep = self._prep(info, n_rows)
-        key = jax.random.fold_in(jax.random.PRNGKey(4177), iteration)
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(4177 + self.seed), iteration)
         gh = rank_gradient(jnp.asarray(margin)[:, 0], key, prep, self.kind,
                            self.num_pairsample, float(self.fix_list_weight))
         return gh[:, None, :]
@@ -91,7 +95,8 @@ class LambdaRankObj(Objective):
         kind = self.kind
         nps = self.num_pairsample
         flw = float(self.fix_list_weight)
-        key_tag = ("rank_fused", kind, nps, flw)
+        seed = self.seed
+        key_tag = ("rank_fused", kind, nps, flw, self.seed)
         if key_tag in info._dev_cache:
             return info._dev_cache[key_tag]
         prep_fn = self._prep
@@ -100,7 +105,8 @@ class LambdaRankObj(Objective):
             # prep is built host-side at TRACE time (margin.shape is
             # static there) and enters the jaxpr as constants
             prep = prep_fn(info, margin.shape[0])
-            key = jax.random.fold_in(jax.random.PRNGKey(4177), iteration)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(4177 + seed), iteration)
             gh = rank_gradient(margin[:, 0], key, prep, kind, nps, flw)
             return gh[:, None, :]
 
@@ -121,7 +127,8 @@ class LambdaRankObj(Objective):
         # group-less and receive zero gradient
         assert gptr[-1] <= len(labels), \
             "group structure not consistent with #rows"
-        rng = np.random.RandomState(iteration * 1111 + 17)
+        rng = np.random.RandomState(
+            iteration * 1111 + 17 + self.seed * 7919)
         grad = np.zeros(len(labels), dtype=np.float64)
         hess = np.zeros(len(labels), dtype=np.float64)
         for k in range(len(gptr) - 1):
