@@ -274,10 +274,15 @@ class Booster:
             elif getattr(self.gbtree, "exact_raw", False):
                 # exact mode is bin-free: entries hold RAW values (NaN =
                 # missing); trees route by value comparison
+                raw, has_miss, raw_host = self._raw_dense(dmat)
                 entry = _CacheEntry(
-                    dmat, self._raw_dense(dmat),
+                    dmat, raw,
                     self._base_margin_of(dmat, dmat.num_row))
-                entry.exact_data = None  # built lazily for TRAIN matrices
+                # static per-dataset fact: lets the exact grower elide
+                # the default-left scan + end-of-scan candidates
+                entry.exact_has_missing = has_miss
+                entry.exact_ranks = None  # built lazily on first boost
+                entry.exact_host = raw_host  # dropped after rank build
                 self._cache[key] = entry
             else:
                 binned = jnp.asarray(bin_matrix(dmat, self.gbtree.cuts))
@@ -457,14 +462,20 @@ class Booster:
                             row_valid=row_valid, n_real=dmat.global_num_row)
         return entry
 
-    def _raw_dense(self, dmat) -> jax.Array:
-        """Dense raw-value device matrix for exact mode (NaN = missing),
-        feature-padded/truncated to the model width."""
+    def _raw_dense(self, dmat):
+        """Dense raw-value matrix for exact mode (NaN = missing),
+        feature-padded/truncated to the model width.  Returns
+        (device matrix, has_missing, host matrix) — has_missing is a
+        static per-dataset fact the exact grower specializes on; the
+        host copy feeds the one-off rank build for training matrices."""
         X = dmat.to_dense(missing=np.nan)
+        X = X[:, :self.num_feature]
+        has_missing = bool(np.isnan(X).any())
         if X.shape[1] < self.num_feature:
             X = np.pad(X, ((0, 0), (0, self.num_feature - X.shape[1])),
                        constant_values=np.nan)
-        return jnp.asarray(X[:, :self.num_feature])
+            has_missing = True
+        return jnp.asarray(X), has_missing, X
 
     def _replicated(self, x):
         """Make a device value fully addressable for host pulls: in
@@ -703,20 +714,24 @@ class Booster:
             entry.applied = self.gbtree.num_trees
             return
         grows = any(u.startswith("grow") or u == "distcol" for u in ups)
-        if grows and getattr(self.gbtree, "exact_raw", False):
-            # install this matrix's static sort structures (one-off)
-            if getattr(entry, "exact_data", None) is None:
-                from xgboost_tpu.models.colmaker import build_exact_data
-                vs, od, nf = build_exact_data(np.asarray(entry.binned))
-                entry.exact_data = (jnp.asarray(vs), jnp.asarray(od),
-                                    jnp.asarray(nf))
-            self.gbtree.set_exact_data(*entry.exact_data)
+        if grows and getattr(self.gbtree, "exact_raw", False) \
+                and getattr(entry, "exact_ranks", None) is None:
+            # one-off: dense-rank structures for the single-key sort
+            # (colmaker.build_exact_ranks; host argsort on the matrix
+            # _raw_dense already densified, then resident on device
+            # for every subsequent round)
+            from xgboost_tpu.models.colmaker import build_exact_ranks
+            rk, uq = build_exact_ranks(entry.exact_host)
+            entry.exact_ranks = (jnp.asarray(rk), jnp.asarray(uq))
+            entry.exact_host = None
         if grows:
-            _, delta = self.gbtree.do_boost(entry.binned, gh, key,
-                                            row_valid=entry.row_valid,
-                                            mesh=self._mesh,
-                                            col_mesh=self._col_mesh,
-                                            root=entry.root)
+            _, delta = self.gbtree.do_boost(
+                entry.binned, gh, key, row_valid=entry.row_valid,
+                mesh=self._mesh, col_mesh=self._col_mesh,
+                root=entry.root,
+                exact_has_missing=getattr(entry, "exact_has_missing",
+                                          True),
+                exact_ranks=getattr(entry, "exact_ranks", None))
             entry.margin = entry.margin + delta
             entry.applied = self.gbtree.num_trees
         if "refresh" in ups:
@@ -811,7 +826,7 @@ class Booster:
                 binned = self.gbtree.device_matrix(data)
             elif getattr(self.gbtree, "exact_raw", False):
                 # exact mode routes on RAW values (no bins exist)
-                binned = self._raw_dense(data)
+                binned = self._raw_dense(data)[0]
             else:
                 binned = jnp.asarray(bin_matrix(data, self.gbtree.cuts))
             base = self._base_margin_of(data, data.num_row)

@@ -211,7 +211,9 @@ class GBTree:
     def do_boost(self, binned: jax.Array, gh: jax.Array, key: jax.Array,
                  row_valid: Optional[jax.Array] = None,
                  mesh=None, col_mesh=None,
-                 root: Optional[jax.Array] = None
+                 root: Optional[jax.Array] = None,
+                 exact_has_missing: bool = True,
+                 exact_ranks=None
                  ) -> Tuple[List[TreeArrays], jax.Array]:
         """One boosting round: grows num_output_group × num_parallel_tree
         trees (reference BoostNewTrees, gbtree-inl.hpp:238-273), then runs
@@ -250,7 +252,8 @@ class GBTree:
                 "num_roots to the number of tree roots")
         if self.exact_raw:
             return self._do_boost_exact(binned, gh, key, row_valid,
-                                        do_prune, K, npar)
+                                        do_prune, K, npar,
+                                        exact_has_missing, exact_ranks)
         if (col_mesh is None and K * npar > 1
                 and not os.environ.get("XGBTPU_SEQ_BOOST")):
             return self._do_boost_vmapped(binned, gh, key, row_valid, mesh,
@@ -312,21 +315,14 @@ class GBTree:
         self._stack_cache = None
         return new_trees, jnp.stack(deltas, axis=1)
 
-    def set_exact_data(self, vals_sorted, order, n_finite) -> None:
-        """Install the training matrix's static sort structures (built by
-        the learner entry; colmaker.build_exact_data)."""
-        self._exact_data = (vals_sorted, order, n_finite)
-
     def _do_boost_exact(self, X, gh, key, row_valid, do_prune: bool,
-                        K: int, npar: int):
+                        K: int, npar: int, has_missing: bool = True,
+                        exact_ranks=None):
         """Exact-greedy round: sequential per-tree growth (the exact
         scans don't share a one-hot, so there is nothing to batch)."""
         from xgboost_tpu.models.colmaker import grow_tree_exact
         from xgboost_tpu.models.updaters import prune_tree
         from xgboost_tpu.parallel import mock
-        assert getattr(self, "_exact_data", None) is not None, \
-            "exact mode: set_exact_data was not called for this matrix"
-        vs, od, nf = self._exact_data
         if self.cfg.n_roots > 1:
             raise NotImplementedError(
                 "num_roots > 1 is not supported by the exact grower")
@@ -337,8 +333,11 @@ class GBTree:
             for t in range(npar):
                 mock.collective()
                 tkey = jax.random.fold_in(key, k * npar + t)
+                rk, uq = exact_ranks if exact_ranks is not None \
+                    else (None, None)
                 tree, row_leaf = grow_tree_exact(
-                    tkey, X, vs, od, nf, gh[:, k, :], self.cfg, row_valid)
+                    tkey, X, gh[:, k, :], self.cfg, row_valid,
+                    has_missing=has_missing, rank_t=rk, uniq=uq)
                 if do_prune:
                     tree, resolve = prune_tree(tree, self.param.gamma)
                     d = table_lookup(tree.leaf_value[jnp.asarray(resolve)],
