@@ -1,11 +1,22 @@
-"""Benchmark: Higgs-scale gbtree training throughput on one TPU chip.
+"""Benchmark: gbtree training throughput on one TPU chip, 3 workloads.
 
-Reproduces the shape of the reference's headline benchmark
-(``demo/kaggle-higgs/speedtest.py``: depth 6, eta 0.1, binary logistic —
-the config behind the "20x faster than sklearn" README claim): trains
-``BENCH_ROUNDS`` boosted trees of depth 6 on a synthetic 1M x 28
-Higgs-like dataset and reports training-row throughput per chip plus the
-achieved AUC on a held-out split.
+Primary metric reproduces the shape of the reference's headline
+benchmark (``demo/kaggle-higgs/speedtest.py``: depth 6, eta 0.1, binary
+logistic — the config behind the "20x faster than sklearn" README
+claim): trains ``BENCH_ROUNDS`` boosted trees of depth 6 on a synthetic
+1M x 28 Higgs-like dataset and reports training-row throughput per chip
+plus the achieved AUC on a held-out split.
+
+The SAME json line also carries the other two workload families the
+reference benchmarks (VERDICT r3 item 4 — a regression in either is now
+driver-visible in BENCH_r*.json):
+
+  - ``multiclass_ms_per_round``: 6-class softmax on 200k x 28
+    (``demo/multiclass_classification`` shape) — exercises the vmapped
+    K-tree ensemble growth path.
+  - ``rank_rounds_per_sec``: rank:ndcg on 1M rows in 10k groups
+    (``demo/rank`` shape) — exercises the fused device LambdaRank
+    gradient.
 
 Baseline for ``vs_baseline``: the reference CLI's MEASURED Higgs-1M
 single-thread training rate from ``PARITY.json`` (produced by
@@ -17,7 +28,9 @@ pod-vs-socket wall-clock ratio under (generous) linear CPU scaling —
 the BASELINE.md target is >= 10.  Fallback when PARITY.json is absent:
 the pre-measurement estimate 8e4 rows/s.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
+"multiclass_ms_per_round", "rank_rounds_per_sec", ...}.
+``BENCH_WORKLOADS`` (comma list of binary,multiclass,rank) trims it.
 """
 
 import json
@@ -42,75 +55,137 @@ def make_higgs_like(n, f=28, seed=42):
     return X, y
 
 
+def _barrier_entry(bst, d):
+    """True device barrier: block_until_ready is advisory on
+    remote-attached backends (see PROFILE.md); a one-element host pull
+    drains the in-order stream."""
+    import jax
+    m = bst._cache[id(d)].margin
+    jax.block_until_ready(m)
+    jax.device_get(m.ravel()[:1])
+
+
+def _time_training(xgb, params, d, rounds):
+    """Shared timing harness: one warm-up booster pays all jit
+    compilation (round-0 single launch + the fused (rounds-1)-round
+    scan); then best-of-BENCH_REPS fresh boosters hitting the shared
+    jit caches (the tunnel-attached chip shows run-to-run interference
+    of +-25%).  Returns (best seconds for rounds-1 rounds, last bst)."""
+    warm = xgb.Booster(params, cache=[d])
+    warm.update(d, 0)
+    warm.update_many(d, 1, rounds - 1)
+    _barrier_entry(warm, d)
+    del warm
+    dt = float("inf")
+    for _ in range(int(os.environ.get("BENCH_REPS", 3))):
+        bst = xgb.Booster(params, cache=[d])
+        bst.update(d, 0)
+        _barrier_entry(bst, d)
+        t0 = time.perf_counter()
+        bst.update_many(d, 1, rounds - 1)
+        _barrier_entry(bst, d)
+        dt = min(dt, time.perf_counter() - t0)
+    return dt, bst
+
+
+def bench_multiclass():
+    """6-class softmax, 200k x 28, depth 6 (demo/multiclass_classification
+    shape scaled up; exercises the vmapped ensemble growth).  Returns
+    (ms_per_round, merror)."""
+    import xgboost_tpu as xgb
+
+    n, rounds = 200_000, 60
+    rng = np.random.RandomState(7)
+    X = rng.randn(n + 20_000, 28).astype(np.float32)
+    centers = rng.randn(6, 28).astype(np.float32) * 1.2
+    logits = X @ centers.T + 0.8 * rng.randn(n + 20_000, 6)
+    y = logits.argmax(axis=1).astype(np.float32)
+    d = xgb.DMatrix(X[:n], label=y[:n])
+    dte = xgb.DMatrix(X[n:], label=y[n:])
+    params = {"objective": "multi:softmax", "num_class": 6,
+              "max_depth": 6, "eta": 0.3, "max_bin": 64}
+    dt, bst = _time_training(xgb, params, d, rounds)
+    pred = bst.predict(dte)
+    merror = float((pred != y[n:]).mean())
+    return dt / (rounds - 1) * 1e3, merror
+
+
+def bench_rank():
+    """rank:ndcg, 1M rows in 10k groups of 100, depth 6 (demo/rank
+    shape scaled up; exercises the fused on-device LambdaRank).
+    Returns (rounds_per_sec, ndcg)."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu import metrics as M
+
+    n, gsize, rounds = 1_000_000, 100, 50
+    rng = np.random.RandomState(11)
+    X = rng.randn(n, 28).astype(np.float32)
+    rel = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+           + 0.5 * rng.randn(n))
+    y = np.clip((rel > 0.5) + (rel > 1.5), 0, 2).astype(np.float32)
+    group = np.full(n // gsize, gsize, np.uint32)
+    d = xgb.DMatrix(X, label=y)
+    d.set_group(group)
+    params = {"objective": "rank:ndcg", "max_depth": 6, "eta": 0.1,
+              "max_bin": 64}
+    dt, bst = _time_training(xgb, params, d, rounds)
+    ndcg = M.ndcg(np.asarray(bst.predict(d)), np.asarray(d.info.label),
+                  None, group_ptr=d.info.group_ptr)
+    return (rounds - 1) / dt, float(ndcg)
+
+
 def main():
     n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
     n_rounds = int(os.environ.get("BENCH_ROUNDS", 100))
+    workloads = [w.strip() for w in os.environ.get(
+        "BENCH_WORKLOADS", "binary,multiclass,rank").split(",")]
     import xgboost_tpu as xgb
     from xgboost_tpu import metrics
 
-    X, y = make_higgs_like(n_rows + 100_000)
-    Xtr, ytr = X[:n_rows], y[:n_rows]
-    Xte, yte = X[n_rows:], y[n_rows:]
-    dtrain = xgb.DMatrix(Xtr, label=ytr)
-    dtest = xgb.DMatrix(Xte, label=yte)
+    out = {}
+    if "binary" in workloads:
+        X, y = make_higgs_like(n_rows + 100_000)
+        Xtr, ytr = X[:n_rows], y[:n_rows]
+        Xte, yte = X[n_rows:], y[n_rows:]
+        dtrain = xgb.DMatrix(Xtr, label=ytr)
+        dtest = xgb.DMatrix(Xte, label=yte)
 
-    # max_bin=64: AUC-equal to the sketch's eps-driven 67 bins on this
-    # task (measured 0.9455 at both, 100 rounds) and MXU-aligned — the
-    # histogram dot's cost scales with ceil(n_bin/8) sublane chunks
-    params = {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
-              "max_bin": 64, "eval_metric": "auc"}
-    import jax
+        # max_bin=64: AUC-equal to the sketch's eps-driven 67 bins on
+        # this task (measured 0.9455 at both, 100 rounds) and
+        # MXU-aligned — the histogram dot's cost scales with
+        # ceil(n_bin/8) sublane chunks
+        params = {"objective": "binary:logistic", "max_depth": 6,
+                  "eta": 0.1, "max_bin": 64, "eval_metric": "auc"}
+        dt, bst = _time_training(xgb, params, dtrain, n_rounds)
 
-    def barrier(b):
-        # block_until_ready is advisory on remote-attached backends
-        # (see PROFILE.md); a one-element host pull is a true barrier
-        # on the in-order stream
-        m = b._cache[id(dtrain)].margin
-        jax.block_until_ready(m)
-        jax.device_get(m.ravel()[:1])
+        rounds_per_sec = (n_rounds - 1) / dt
+        rows_per_sec = rounds_per_sec * n_rows
+        auc = metrics.auc(bst.predict(dtest), yte, np.ones_like(yte))
 
-    # warm-up booster pays all jit compilation (round-0 single-round
-    # launch + the fused (n_rounds-1)-round scan); the timed booster
-    # then hits the shared jit caches
-    warm = xgb.Booster(params, cache=[dtrain])
-    warm.update(dtrain, 0)
-    warm.update_many(dtrain, 1, n_rounds - 1)
-    barrier(warm)
-    del warm
-
-    # the tunnel-attached chip shows run-to-run interference; report the
-    # best of BENCH_REPS full runs (each: one fused launch of all
-    # remaining rounds on a fresh booster hitting the shared jit cache)
-    reps = int(os.environ.get("BENCH_REPS", 3))
-    dt = float("inf")
-    for _ in range(reps):
-        bst = xgb.Booster(params, cache=[dtrain])
-        bst.update(dtrain, 0)
-        barrier(bst)
-        t0 = time.perf_counter()
-        bst.update_many(dtrain, 1, n_rounds - 1)
-        barrier(bst)
-        dt = min(dt, time.perf_counter() - t0)
-
-    rounds_per_sec = (n_rounds - 1) / dt
-    rows_per_sec = rounds_per_sec * n_rows
-    auc = metrics.auc(bst.predict(dtest), yte, np.ones_like(yte))
-
-    baseline_rows_per_sec = 8e4  # pre-measurement fallback (see docstring)
-    parity = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "PARITY.json")
-    if os.path.exists(parity):
-        with open(parity) as f:
-            measured = json.load(f).get("baseline_1m", {})
-        baseline_rows_per_sec = measured.get("rows_per_sec_1thread",
-                                             baseline_rows_per_sec)
-    print(json.dumps({
-        "metric": "higgs1m_train_rows_per_sec_per_chip",
-        "value": round(rows_per_sec, 1),
-        "unit": f"rows/s (depth6 x {n_rounds} rounds, 1 chip; "
-                f"auc={auc:.4f}, rounds/s={rounds_per_sec:.2f})",
-        "vs_baseline": round(rows_per_sec / baseline_rows_per_sec, 2),
-    }))
+        baseline_rows_per_sec = 8e4  # pre-measurement fallback (docstring)
+        parity = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "PARITY.json")
+        if os.path.exists(parity):
+            with open(parity) as f:
+                measured = json.load(f).get("baseline_1m", {})
+            baseline_rows_per_sec = measured.get("rows_per_sec_1thread",
+                                                 baseline_rows_per_sec)
+        out = {
+            "metric": "higgs1m_train_rows_per_sec_per_chip",
+            "value": round(rows_per_sec, 1),
+            "unit": f"rows/s (depth6 x {n_rounds} rounds, 1 chip; "
+                    f"auc={auc:.4f}, rounds/s={rounds_per_sec:.2f})",
+            "vs_baseline": round(rows_per_sec / baseline_rows_per_sec, 2),
+        }
+    if "multiclass" in workloads:
+        mc_ms, mc_err = bench_multiclass()
+        out["multiclass_ms_per_round"] = round(mc_ms, 2)
+        out["multiclass_merror"] = round(mc_err, 4)
+    if "rank" in workloads:
+        rk_rps, rk_ndcg = bench_rank()
+        out["rank_rounds_per_sec"] = round(rk_rps, 2)
+        out["rank_ndcg"] = round(rk_ndcg, 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
