@@ -24,6 +24,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration drives (demo suite)")
+
+
 @pytest.fixture
 def mesh8():
     """The 8-virtual-device data-parallel mesh."""

@@ -1,0 +1,67 @@
+"""Remote-URI ingestion (VERDICT r3 item 8; reference io.cpp:32-35
+routes s3://, hdfs:// etc. to dmlc-core's filesystem layer).
+
+The seam has three openers (io/dispatch._fetch_remote): the
+XGBTPU_REMOTE_CAT command override, scheme CLI clients, and fsspec.
+These tests exercise the override (a mocked "s3") and fsspec's
+memory:// filesystem end to end — including training from the fetched
+matrix and the #cache fast path."""
+
+import os
+import stat
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+AGARICUS = "/root/reference/demo/data/agaricus.txt.train"
+
+
+def _head(path, n_lines=400):
+    with open(path, "rb") as f:
+        return b"".join(f.readline() for _ in range(n_lines))
+
+
+def test_remote_cat_override_trains(tmp_path, monkeypatch):
+    """s3:// URI through a mocked fetcher command — the full pipeline
+    (fetch -> parse -> train) and the #cache skip on reload."""
+    local = tmp_path / "train.svm"
+    local.write_bytes(_head(AGARICUS))
+    fetcher = tmp_path / "fake_s3_cat.sh"
+    fetcher.write_text(
+        "#!/bin/sh\n"
+        f"exec cat {local}\n")
+    fetcher.chmod(fetcher.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("XGBTPU_REMOTE_CAT", str(fetcher))
+
+    cache = tmp_path / "c"
+    d = xgb.DMatrix(f"s3://fake-bucket/train.svm#{cache}")
+    ref = xgb.DMatrix(str(local))
+    assert d.num_row == ref.num_row and d.num_col == ref.num_col
+
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 2},
+                    d, 1, verbose_eval=False)
+    assert len(bst.predict(d)) == d.num_row
+
+    # second load must come from the cache, not the fetcher
+    monkeypatch.setenv("XGBTPU_REMOTE_CAT", "/nonexistent-fetcher")
+    d2 = xgb.DMatrix(f"s3://fake-bucket/train.svm#{cache}")
+    assert d2.num_row == d.num_row
+
+
+def test_fsspec_memory_filesystem(tmp_path):
+    """Any fsspec-registered protocol works without a CLI client."""
+    fsspec = pytest.importorskip("fsspec")
+    blob = _head(AGARICUS, 200)
+    with fsspec.open("memory://bucket/part0.svm", "wb") as f:
+        f.write(blob)
+    d = xgb.DMatrix("memory://bucket/part0.svm")
+    assert d.num_row == 200
+    assert np.isfinite(np.asarray(d.info.label)).all()
+
+
+def test_unknown_scheme_names_all_seams(monkeypatch):
+    monkeypatch.delenv("XGBTPU_REMOTE_CAT", raising=False)
+    with pytest.raises(ValueError, match="XGBTPU_REMOTE_CAT"):
+        xgb.DMatrix("nosuchscheme://bucket/x.svm")

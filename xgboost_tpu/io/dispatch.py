@@ -20,16 +20,30 @@ def load_dmatrix_into(dmat, uri: str, silent: bool = True,
       - ``file.txt``              — libsvm text
       - ``file.txt#cache``        — libsvm text with binary cache file
       - ``file.npz``              — saved binary DMatrix
-      - ``s3://`` / ``hdfs://``   — remote text, streamed through a local
-        filesystem client (reference io.cpp:32-35 routes these to the
-        dmlc text loader and ERRORS without a dmlc build; here the
-        "build" is having ``aws``/``gsutil``/``hdfs`` on PATH)
+      - ``file://...``            — local path in URI form
+      - ``scheme://...``          — remote text (s3, gs, hdfs, http,
+        abfs, memory, ...), streamed through the first available
+        opener: the ``XGBTPU_REMOTE_CAT`` command override, a scheme
+        CLI client on PATH (``aws``/``gsutil``/``hdfs``), or an fsspec
+        driver (reference io.cpp:32-35 routes these to dmlc-core's
+        filesystem layer and errors without a dmlc build; the error
+        here names all three seams)
     """
     path, _, cache = uri.partition("#")
     if nparts > 1 and cache:
         cache = f"{cache}.r{rank}-{nparts}"  # per-rank cache (io.cpp:56-61)
 
-    remote = path.startswith(("s3://", "hdfs://", "gs://"))
+    # any scheme-qualified URI is remote (s3/gs/hdfs via CLI clients or
+    # fsspec; anything else — http, abfs, memory, ... — via fsspec)
+    remote = "://" in path and not path.startswith("file://")
+    if path.startswith("file://"):
+        # RFC 8089 forms: file:///p, file://localhost/p, %-escapes
+        from urllib.parse import unquote, urlparse
+        u = urlparse(path)
+        if u.netloc not in ("", "localhost"):
+            raise ValueError(f"{uri}: file:// URIs must be local "
+                             f"(host {u.netloc!r} is not)")
+        path = unquote(u.path)
     if remote:
         cache_file = cache + ".npz" if cache else None
         if cache_file and os.path.exists(cache_file):
@@ -109,17 +123,23 @@ def _load_local(dmat, path: str, cache: str, uri: str, silent: bool,
 
 
 def _fetch_remote(uri: str) -> str:
-    """Stream a remote text object to a local temp file via whichever
-    filesystem client is installed.  The reference delegates these
-    schemes to dmlc-core's filesystem layer and refuses without it
-    (io.cpp:32-35); the equivalent here is a clear error naming the
-    missing client.  Env override ``XGBTPU_REMOTE_CAT`` supplies a
-    custom ``<cmd> <uri>``-to-stdout fetcher."""
+    """Stream a remote text object to a local temp file.
+
+    Opener order (the pluggable seam; reference delegates these schemes
+    to dmlc-core's filesystem layer and refuses without a dmlc build,
+    io.cpp:32-35):
+      1. ``XGBTPU_REMOTE_CAT`` env — custom ``<cmd> <uri>``-to-stdout
+         fetcher (also the test seam);
+      2. a scheme CLI client on PATH (``aws`` / ``gsutil`` / ``hdfs``);
+      3. ``fsspec``, which covers every protocol it has a driver for
+         (s3 via s3fs, gs via gcsfs, http, abfs, memory, ...).
+    A clear error names all three seams when none applies."""
     import shutil
     import subprocess
     import tempfile
 
     custom = os.environ.get("XGBTPU_REMOTE_CAT")
+    cmd = None
     if custom:
         cmd = custom.split() + [uri]
     elif uri.startswith("s3://") and shutil.which("aws"):
@@ -128,22 +148,45 @@ def _fetch_remote(uri: str) -> str:
         cmd = ["gsutil", "cat", uri]
     elif uri.startswith("hdfs://") and shutil.which("hdfs"):
         cmd = ["hdfs", "dfs", "-cat", uri]
-    else:
-        scheme = uri.split("://", 1)[0]
-        client = {"s3": "aws", "gs": "gsutil", "hdfs": "hdfs"}.get(
-            scheme, "?")
-        raise ValueError(
-            f"{uri}: no filesystem client for {scheme}:// on PATH "
-            f"(need `{client}`, or set XGBTPU_REMOTE_CAT to a command "
-            "that streams the object to stdout)")
+
     with tempfile.NamedTemporaryFile("wb", suffix=".libsvm",
                                      delete=False) as tf:
         try:
-            subprocess.run(cmd, stdout=tf, check=True)
-        except (subprocess.CalledProcessError, OSError) as e:
+            if cmd is not None:
+                subprocess.run(cmd, stdout=tf, check=True)
+                return tf.name
+            try:
+                import fsspec
+            except ImportError:
+                fsspec = None
+            if fsspec is not None:
+                try:
+                    with fsspec.open(uri, "rb") as src:
+                        shutil.copyfileobj(src, tf)
+                    return tf.name
+                except (ImportError, ValueError) as e:
+                    # no driver for the scheme (s3fs/gcsfs not
+                    # installed) — fall through to the naming error
+                    fs_err = f" (fsspec: {e})"
+            else:
+                fs_err = " (fsspec not installed)"
+            scheme = uri.split("://", 1)[0]
+            client = {"s3": "aws", "gs": "gsutil", "hdfs": "hdfs"}.get(
+                scheme)
+            hint = f"`{client}` on PATH, " if client else ""
+            raise ValueError(
+                f"{uri}: no opener for {scheme}:// — need {hint}an "
+                f"fsspec driver for {scheme}, or XGBTPU_REMOTE_CAT set "
+                f"to a command that streams the object to stdout"
+                f"{fs_err}")
+        except BaseException as e:
+            # never leak the temp file, whatever the opener raised
+            # (botocore/aiohttp/... errors included); other Exceptions
+            # are wrapped so callers see one failure type
             os.unlink(tf.name)
+            if isinstance(e, ValueError) or not isinstance(e, Exception):
+                raise
             raise ValueError(f"fetching {uri} failed: {e}")
-        return tf.name
 
 
 def _load_npz(path: str):
