@@ -67,8 +67,28 @@ def main():
     preds = bst_s.predict(dm_s)
     assert preds.shape == (dm_s.local_num_row,), preds.shape
 
+    # EXACT distributed AUC (dist_auc=exact, the default) must equal
+    # the replicated-load AUC; the reference-compat approximation
+    # (mean of per-shard AUCs, evaluation-inl.hpp:405-414) is kept
+    # behind dist_auc=approx
+    auc_params = dict(params, eval_metric="auc")
+    r_exact, r_approx, r_repl = {}, {}, {}
+    xgb.train(auc_params, xgb.ShardedDMatrix(path), 3,
+              evals=[(dm_s, "train")], evals_result=r_exact,
+              verbose_eval=False)
+    xgb.train(dict(auc_params, dist_auc="approx"),
+              xgb.ShardedDMatrix(path), 3, evals=[(dm_s, "train")],
+              evals_result=r_approx, verbose_eval=False)
+    xgb.train(dict(auc_params, device_sketch=1), xgb.DMatrix(path), 3,
+              evals=[(xgb.DMatrix(path), "train")], evals_result=r_repl,
+              verbose_eval=False)
+
     with open(f"{out_prefix}.rank{rank}.result", "w") as f:
         f.write(f"{bitmatch} {bitmatch_e} {err:.6f}\n")
+    with open(f"{out_prefix}.rank{rank}.auc", "w") as f:
+        f.write(f"{r_exact['train-auc'][-1]:.9f} "
+                f"{r_approx['train-auc'][-1]:.9f} "
+                f"{r_repl['train-auc'][-1]:.9f}\n")
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices("done")
 

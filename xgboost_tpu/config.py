@@ -117,6 +117,11 @@ class TrainParam:
     seed: int = 0
     seed_per_iteration: bool = False
     dsplit: str = "auto"  # auto | row | col
+    # distributed AUC on split-loaded eval data: "exact" merges
+    # per-shard (value, pos_w, neg_w) runs into the true global AUC;
+    # "approx" keeps the reference's mean-of-per-shard-AUCs
+    # (evaluation-inl.hpp:405-414)
+    dist_auc: str = "exact"
     nthread: int = 0
     silent: int = 0
     # profiling (SURVEY.md §5.1): 1 = per-round phase timing,

@@ -920,9 +920,20 @@ class Booster:
                     "partial-sum form; supported on split-loaded data: "
                     f"{sorted(_DIST_METRICS)}")
             p = preds if preds.shape[1] > 1 else preds[:, 0]
-            partial = m.partial_fn(p, labels, weights, None)
-            total = dmat.allsum(partial)
-            parts.append(f"{name}-{m.metric_name}:{m.finalize_fn(total):.6f}")
+            if (m.metric_name == "auc"
+                    and self.param.dist_auc != "approx"):
+                # EXACT global AUC: allgather per-shard value runs and
+                # merge (metrics.auc_compress docstring; the
+                # reference's mean-of-shards stays behind
+                # dist_auc=approx)
+                from xgboost_tpu.metrics import (auc_compress,
+                                                 auc_exact_from_runs)
+                runs = dmat.allgatherv(auc_compress(p, labels, weights))
+                val = auc_exact_from_runs(runs)
+            else:
+                partial = m.partial_fn(p, labels, weights, None)
+                val = m.finalize_fn(dmat.allsum(partial))
+            parts.append(f"{name}-{m.metric_name}:{val:.6f}")
 
     def eval(self, data: DMatrix, name: str = "eval", iteration: int = 0) -> str:
         return self.eval_set([(data, name)], iteration)

@@ -271,6 +271,29 @@ class ShardedDMatrix:
         return np.asarray(mhu.process_allgather(np.int64(x)))
 
     @staticmethod
+    def allgatherv(mat: np.ndarray) -> np.ndarray:
+        """Concatenate per-process float64 (k_i, C) matrices across
+        processes (variable k_i; rows padded to the max and trimmed
+        after the gather).  Carries the exact-AUC value runs — the
+        reference has no equivalent collective because it approximates
+        instead (evaluation-inl.hpp:405-414)."""
+        import jax
+        m = np.ascontiguousarray(np.asarray(mat, np.float64))
+        if jax.process_count() == 1:
+            return m
+        from jax.experimental import multihost_utils as mhu
+        lens = np.asarray(mhu.process_allgather(np.int64(m.shape[0])))
+        kmax = int(lens.max())
+        pad = np.zeros((kmax, m.shape[1]), np.float64)
+        pad[:m.shape[0]] = m
+        buf = np.frombuffer(pad.tobytes(), np.uint8)
+        gathered = np.asarray(mhu.process_allgather(buf))
+        out = np.frombuffer(gathered.tobytes(), np.float64).reshape(
+            jax.process_count(), kmax, m.shape[1])
+        return np.concatenate(
+            [out[i, :lens[i]] for i in range(len(lens))], axis=0)
+
+    @staticmethod
     def allsum(vec: np.ndarray) -> np.ndarray:
         """Sum a small float64 host vector across processes exactly (the
         metric (sum, wsum) allreduce role).  Bytes ride the gather as
