@@ -87,7 +87,8 @@ def test_cli_save_period_and_checkpoint_resume(svm_data, tmp_path):
     assert os.path.exists(tp / "0002.model")
     assert os.path.exists(tp / "0004.model")
     # newest two checkpoints kept
-    kept = sorted(os.listdir(ckpt))
+    # the persistent jit cache lives alongside the ring (RECOVERY.md)
+    kept = sorted(f for f in os.listdir(ckpt) if f.startswith("ckpt-"))
     assert kept == ["ckpt-000003.model", "ckpt-000004.model"]
 
     # "kill" after round 4 of 6: rerun with num_round=6 resumes from ckpt 4

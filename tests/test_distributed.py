@@ -330,13 +330,15 @@ def test_hlo_collectives_parser_forms():
   %ar-start = (f32[32,28,64,2], f32[32,28,64,2]) all-reduce-start(f32[32,28,64,2] %p), to_apply=%add
   %ar-done = f32[32,28,64,2] all-reduce-done((f32[32,28,64,2], f32[32,28,64,2]) %ar-start)
   %ag = (f32[8,4], f32[16,4]) all-gather-start(f32[8,4] %x), dimensions={0}
+  %cps = (f32[8,4], f32[8,4], u32[], u32[]) collective-permute-start(f32[8,4] %y), source_target_pairs={{0,1}}
   ROOT %t = (f32[4], f32[8]) all-reduce(f32[4] %a, f32[8] %b), to_apply=%add
 """
     out = hlo_collectives(hlo)
     assert [(op, b) for op, _, b in out] == [
         ("all-reduce", 16 * 28 * 64 * 2 * 4),    # operand NOT counted
-        ("all-reduce", 32 * 28 * 64 * 2 * 4),    # -start: result only
+        ("all-reduce", 32 * 28 * 64 * 2 * 4),    # -start: buffer only
         ("all-gather", 16 * 4 * 4),              # -start: produced buf
+        ("collective-permute", 8 * 4 * 4),       # context u32[]s not
         ("all-reduce", 4 * 4 + 8 * 4),           # fused tuple: both
     ], out
 

@@ -8,6 +8,7 @@ the same global mesh shape.
 """
 
 import os
+import re
 import subprocess
 import sys
 
@@ -223,6 +224,17 @@ def test_two_process_rank_specific_death_gang_restart(tmp_path):
     assert "die at version=2" in r.stderr
     assert "restarting all 2 workers, trial 1" in r.stderr
     assert model.exists()
+    # recovery-cost instrumentation (RECOVERY.md): the launcher
+    # reports attempt/reap timing and the restarted rank 0 reports the
+    # time to its checkpoint-resume point.  The dying worker must exit
+    # HARD: normal interpreter teardown hangs ~minutes in the
+    # jax.distributed client, which this wall-clock bound catches.
+    m = re.search(r"attempt ran ([0-9.]+)s, reap ([0-9.]+)s", r.stderr)
+    assert m, r.stderr[-2000:]
+    assert float(m.group(2)) < 30.0, "reap took too long"
+    m2 = re.search(r"\[ckpt\] resume at round 2 \(([0-9.]+)s", r.stderr)
+    assert m2, r.stderr[-2000:]
+    assert float(m2.group(1)) < 60.0, "resume point took too long"
 
     import xgboost_tpu as xgb
     bst = xgb.Booster(model_file=str(model))

@@ -27,6 +27,8 @@ from typing import Dict, List, Optional, Tuple
 
 from xgboost_tpu.config import parse_config_file
 
+_T0 = time.time()  # process start, for recovery-cost accounting
+
 
 class BoostLearnTask:
     """Training/prediction task state (reference BoostLearnTask)."""
@@ -142,6 +144,23 @@ class BoostLearnTask:
             self.set_param("silent", "1")
             self.save_period = 0
 
+        if (self.checkpoint_dir and self.task == "train"
+                and not os.environ.get("XGBTPU_NO_JITCACHE")):
+            # WARM-CACHE RESTART (RECOVERY.md): persist jit
+            # compilations next to the checkpoint ring, so a gang
+            # restart after a worker failure reloads compiled
+            # executables instead of re-tracing and re-compiling —
+            # the dominant recovery cost otherwise.  Must happen
+            # before any backend use.
+            import jax
+            cache_dir = os.path.join(self.checkpoint_dir, "jitcache")
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+
         # multi-host worker mode (launched by xgboost_tpu.launch or a
         # scheduler exporting XGBTPU_COORD): initialize the distributed
         # runtime BEFORE any backend use, train dsplit=row over the
@@ -161,6 +180,27 @@ class BoostLearnTask:
                     # (and concurrent writes to shared output would race)
                     return 0
 
+        if self._distributed:
+            # die HARD on ANY fatal error (rabit workers just die):
+            # normal interpreter exit hangs ~minutes in the
+            # jax.distributed client teardown trying to reach the
+            # coordinator, and the gang launcher cannot restart the job
+            # until this process is seen dead — measured 330 s vs
+            # sub-second detection (RECOVERY.md).  Covers real failures
+            # (bad input, OOM, metric errors), not just the injector.
+            try:
+                return self._dispatch()
+            except SystemExit:
+                raise
+            except BaseException:
+                import traceback
+                traceback.print_exc()
+                sys.stderr.flush()
+                os._exit(41)
+        return self._dispatch()
+
+    def _dispatch(self) -> int:
+        """Task dispatch after param parsing + distributed init."""
         if self.task == "train":
             if not self.mock_spec:
                 return self.task_train()
@@ -184,6 +224,7 @@ class BoostLearnTask:
                           + ("restarting" if restart else "dead"),
                           file=sys.stderr)
                     if not restart:
+                        # distributed: the run() wrapper os._exit()s
                         raise
                     trial += 1
                 finally:
@@ -308,6 +349,15 @@ class BoostLearnTask:
                 # without a shared checkpoint filesystem
                 bst, start_round = _broadcast_checkpoint(
                     bst, start_round, self.rank, self._params_dict())
+            if start_round and self.rank == 0:
+                # recovery-cost accounting (RECOVERY.md): time from
+                # process start to the resume point — data reload +
+                # distributed re-init + checkpoint load; the jit
+                # recompile cost lands inside the first resumed round
+                # (or not, with the persistent jit cache below)
+                print(f"[ckpt] resume at round {start_round} "
+                      f"({time.time() - _T0:.2f}s from process start)",
+                      file=sys.stderr)
 
         start = time.time()
         # nothing runs on the host between rounds (no eval lines, no

@@ -120,8 +120,11 @@ class TrainParam:
     # distributed AUC on split-loaded eval data: "exact" merges
     # per-shard (value, pos_w, neg_w) runs into the true global AUC;
     # "approx" keeps the reference's mean-of-per-shard-AUCs
-    # (evaluation-inl.hpp:405-414)
+    # (evaluation-inl.hpp:405-414).  Exact gathers one 24-byte run per
+    # distinct predicted value per shard; shards exceeding
+    # dist_auc_max_runs fall back to approx with a warning.
     dist_auc: str = "exact"
+    dist_auc_max_runs: int = 1 << 22
     nthread: int = 0
     silent: int = 0
     # profiling (SURVEY.md §5.1): 1 = per-round phase timing,

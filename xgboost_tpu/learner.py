@@ -923,13 +923,31 @@ class Booster:
             if (m.metric_name == "auc"
                     and self.param.dist_auc != "approx"):
                 # EXACT global AUC: allgather per-shard value runs and
-                # merge (metrics.auc_compress docstring; the
-                # reference's mean-of-shards stays behind
-                # dist_auc=approx)
+                # merge.  Payload is one 24-byte run per DISTINCT
+                # predicted value — for continuous margins that is
+                # ~local_rows runs (24 MB/shard at 1M rows), fine as
+                # an end-of-training eval, heavy as an every-round
+                # one; past dist_auc_max_runs the reference's
+                # mean-of-shards approximation kicks in with a loud
+                # one-time warning (it is also always available
+                # explicitly via dist_auc=approx).
                 from xgboost_tpu.metrics import (auc_compress,
                                                  auc_exact_from_runs)
-                runs = dmat.allgatherv(auc_compress(p, labels, weights))
-                val = auc_exact_from_runs(runs)
+                runs = auc_compress(p, labels, weights)
+                limit = int(getattr(self.param, "dist_auc_max_runs",
+                                    1 << 22))
+                if len(runs) > limit:
+                    if not getattr(self, "_warned_auc_runs", False):
+                        self._warned_auc_runs = True
+                        print(f"[dist-auc] {len(runs)} distinct-value "
+                              f"runs on this shard exceeds "
+                              f"dist_auc_max_runs={limit}; falling "
+                              "back to the reference's approximate "
+                              "mean-of-shards AUC", file=sys.stderr)
+                    partial = m.partial_fn(p, labels, weights, None)
+                    val = m.finalize_fn(dmat.allsum(partial))
+                else:
+                    val = auc_exact_from_runs(dmat.allgatherv(runs))
             else:
                 partial = m.partial_fn(p, labels, weights, None)
                 val = m.finalize_fn(dmat.allsum(partial))

@@ -57,9 +57,11 @@ def hlo_collectives(hlo_text: str) -> List[Tuple[str, str, int]]:
     """[(op, shape, payload_bytes)] for every collective in an HLO
     dump (``jax.jit(f).lower(...).compile().as_text()``).
 
-    Async pairs: the ``-start`` op carries the payload and its tuple
-    result aliases the operand buffer, so only the LAST tuple element
-    (the produced buffer) counts; ``-done`` ops carry none."""
+    Async pairs: a ``-start`` tuple result holds (operand-alias,
+    produced buffer[, u32[] context scalars...]); the payload is the
+    LARGEST element — equal to the buffer for all-reduce /
+    collective-permute and the (bigger) result for all-gather, and
+    never a trailing context scalar.  ``-done`` ops carry none."""
     out = []
     for line in hlo_text.splitlines():
         m = _COLL_RE.match(line)
@@ -69,9 +71,11 @@ def hlo_collectives(hlo_text: str) -> List[Tuple[str, str, int]]:
         parsed = _shapes_in(shapes)
         if not parsed:
             continue
+        sizes = [_one_shape_bytes(t, d) for t, d in parsed]
         if start and shapes.startswith("("):
-            parsed = parsed[-1:]          # (operand-alias, result, ...)
-        payload = sum(_one_shape_bytes(t, d) for t, d in parsed)
+            payload = max(sizes)
+        else:
+            payload = sum(sizes)
         out.append((op, shapes.strip(), payload))
     return out
 

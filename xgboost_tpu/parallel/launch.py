@@ -103,9 +103,13 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def _reap(procs: List[Optional[subprocess.Popen]]) -> None:
-    """Terminate-then-kill every live child and wait() them all (a worker
-    stuck in a collective can ignore SIGTERM)."""
+def _reap(procs: List[Optional[subprocess.Popen]],
+          grace: float = 3.0) -> None:
+    """Terminate-then-kill every live child and wait() them all.  A
+    survivor blocked in a collective of a doomed gang ignores SIGTERM
+    (it is inside the coordination-service wait), so the grace is
+    short: these processes are about to be replaced by the restart and
+    their state is reconstructed from the checkpoint ring anyway."""
     for q in procs:
         if q is not None and q.poll() is None:
             q.terminate()
@@ -113,7 +117,7 @@ def _reap(procs: List[Optional[subprocess.Popen]]) -> None:
         if q is None:
             continue
         try:
-            q.wait(timeout=10)
+            q.wait(timeout=grace)
         except subprocess.TimeoutExpired:
             q.kill()
             q.wait()
@@ -136,6 +140,7 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
     trial = 0
     while True:
         coord = f"localhost:{free_port()}"
+        t_attempt = time.time()
 
         def spawn(rank: int) -> subprocess.Popen:
             env = dict(os.environ)
@@ -165,11 +170,16 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
                     break
         if failed_rc is None:
             return 0
+        t_detect = time.time()
         _reap(procs)
         if not keepalive or trial >= max_restarts:
             return failed_rc
         trial += 1
-        print(f"[launch] restarting all {n} workers, trial {trial}",
+        # recovery-cost accounting (RECOVERY.md): attempt wall time up
+        # to death detection, plus the reap (SIGTERM the survivors)
+        print(f"[launch] restarting all {n} workers, trial {trial} "
+              f"(attempt ran {t_detect - t_attempt:.2f}s, "
+              f"reap {time.time() - t_detect:.2f}s)",
               file=sys.stderr)
 
 
