@@ -49,6 +49,12 @@ def test_c_host_end_to_end(demo_bin, tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    # the axon TPU plugin can re-register itself over JAX_PLATFORMS in
+    # the driver's embedded Python; pin the histogram impl so the
+    # prediction-parity check below compares the SAME numerics (the
+    # TPU default is int8-quantized histograms — ~7e-4 off the CPU
+    # scatter, fine for training, not for a 5e-5 equality assert)
+    env["XGBTPU_HIST"] = "scatter"
     r = subprocess.run([demo_bin, TRAIN, TEST, str(tmp_path / "m.model")],
                        capture_output=True, text=True, timeout=600, env=env)
     assert r.returncode == 0, (r.stdout, r.stderr[-2000:])

@@ -1,0 +1,222 @@
+"""Feature-pair sublane packing prototype (round-4 kernel candidate).
+
+The production histogram dot is (B, R) @ (R, 2M) per feature.  With
+B = 64 the one-hot fills only HALF the MXU's 128 rows, and real split
+levels have M <= 32 (the terminal level derives from parents), so
+lanes are <= 64 too: utilization tops out near 25%.  Packing TWO
+features' one-hots into the sublane dim — onehot2[(f_hi, b), r] —
+makes every dot (2B=128, R) @ (R, 2M): full rows, half the dot count.
+
+Measures prod vs pack2 at every real level size M = 1..32 of the
+bench shape (1M x 28, B = 64).
+"""
+import functools
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from xgboost_tpu.ops.pallas_hist import _round_up  # noqa: E402
+
+N, F, B = 1_000_000, 28, 64
+
+
+def make_kernel(mode, n_bin, m_pad, f_tile):
+    def kernel(binned_ref, pos_ref, gh_ref, out_ref):
+        r_tile = binned_ref.shape[1]
+        m2 = 2 * m_pad
+
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        pos = pos_ref[:, 0]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, m2), 1)
+        node_of_lane = jnp.where(lane < m_pad, lane, lane - m_pad)
+        ghsel = jnp.where(lane < m_pad, gh_ref[:, 0:1], gh_ref[:, 1:2])
+        gh_exp = jnp.where(pos[:, None] == node_of_lane, ghsel,
+                           0.0).astype(jnp.bfloat16)
+
+        bins = binned_ref[:]
+        if mode == "prod":
+            bin_ids = jax.lax.broadcasted_iota(
+                jnp.int32, (n_bin, r_tile), 0)
+            for f in range(f_tile):
+                onehot = (bins[f:f + 1, :] == bin_ids).astype(
+                    jnp.bfloat16)
+                acc = jax.lax.dot_general(
+                    onehot, gh_exp, (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.DEFAULT,
+                    preferred_element_type=jnp.float32)
+                out_ref[0, f * n_bin:(f + 1) * n_bin, :] += acc
+        else:  # pack2: sublane s of 2B encodes (s // B -> f offset, s % B)
+            sub = jax.lax.broadcasted_iota(
+                jnp.int32, (2 * n_bin, r_tile), 0)
+            bin_of_sub = sub % n_bin
+            hi = sub >= n_bin
+            for fp in range(f_tile // 2):
+                b0 = bins[2 * fp:2 * fp + 1, :]
+                b1 = bins[2 * fp + 1:2 * fp + 2, :]
+                bsel = jnp.where(hi, b1, b0)
+                onehot2 = (bsel == bin_of_sub).astype(jnp.bfloat16)
+                acc = jax.lax.dot_general(
+                    onehot2, gh_exp, (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.DEFAULT,
+                    preferred_element_type=jnp.float32)  # (2B, 2M)
+                out_ref[0, 2 * fp * n_bin:(2 * fp + 2) * n_bin, :] += acc
+
+    return kernel
+
+
+def build(mode, m_pad, r_tile=2048):
+    @jax.jit
+    def fn(binned_t, pos, gh):
+        n_pad = binned_t.shape[1]
+        kernel = make_kernel(mode, B, m_pad, F)
+        return pl.pallas_call(
+            kernel,
+            grid=(1, 1, n_pad // r_tile),
+            in_specs=[
+                pl.BlockSpec((F, r_tile), lambda mi, fi, ri: (fi, ri)),
+                pl.BlockSpec((r_tile, 1), lambda mi, fi, ri: (ri, 0)),
+                pl.BlockSpec((r_tile, 2), lambda mi, fi, ri: (ri, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, F * B, 2 * m_pad),
+                                   lambda mi, fi, ri: (mi, fi, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, F * B, 2 * m_pad),
+                                           jnp.float32),
+        )(binned_t, pos, gh)
+
+    return fn
+
+
+def timed(fn, binned_t, pos, gh, iters=30):
+    @jax.jit
+    def loop(b, p, g):
+        def body(c, _):
+            out = fn(b, p, g + c * 1e-20)
+            return c + jnp.sum(out[0, :2, :2]) % 7.0 * 1e-20, None
+        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=iters)
+        return c
+
+    r = loop(binned_t, pos, gh); jax.block_until_ready(r); float(r)
+    t0 = time.perf_counter()
+    float(loop(binned_t, pos, gh))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n_pad = _round_up(N, 8192)
+    binned = jnp.asarray(rng.randint(0, B, (F, n_pad)).astype(np.int32))
+    gh = jnp.asarray(rng.randn(n_pad, 2).astype(np.float32))
+
+    tot = {"prod": 0.0, "pack2": 0.0}
+    print(f"{'M':>3s} {'prod ms':>8s} {'pack2 ms':>8s}")
+    for d in range(6):
+        m = 1 << d
+        pos = jnp.asarray(rng.randint(0, m, (n_pad, 1)).astype(np.int32))
+        row = [m]
+        for mode in ("prod", "pack2"):
+            ms = timed(build(mode, m), binned, pos, gh)
+            tot[mode] += ms
+            row.append(ms)
+        print(f"{row[0]:3d} {row[1]:8.2f} {row[2]:8.2f}")
+    # correctness spot check at M=32
+    pos = jnp.asarray(rng.randint(0, 32, (n_pad, 1)).astype(np.int32))
+    a = build("prod", 32)(binned, pos, gh)
+    b = build("pack2", 32)(binned, pos, gh)
+    ok = bool(jnp.allclose(a, b, atol=1e-3, rtol=1e-3))
+    print(f"\nper-round hist totals: prod {tot['prod']:.1f} ms, "
+          f"pack2 {tot['pack2']:.1f} ms  (match at M=32: {ok})")
+
+
+if __name__ == "__main__":
+    main()
+
+
+def make_onebig_kernel(n_bin, m_pad, f_tile):
+    def kernel(binned_ref, pos_ref, gh_ref, out_ref):
+        r_tile = binned_ref.shape[1]
+        m2 = 2 * m_pad
+
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        pos = pos_ref[:, 0]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, m2), 1)
+        node_of_lane = jnp.where(lane < m_pad, lane, lane - m_pad)
+        ghsel = jnp.where(lane < m_pad, gh_ref[:, 0:1], gh_ref[:, 1:2])
+        gh_exp = jnp.where(pos[:, None] == node_of_lane, ghsel,
+                           0.0).astype(jnp.bfloat16)
+
+        # ONE (F*B, R) one-hot + ONE dot per row tile: the per-feature
+        # loop alternates VPU one-hot builds with small MXU dots and is
+        # issue-bound (flat in M); the concatenated form pipelines
+        bins_rep = jnp.repeat(binned_ref[:], n_bin, axis=0)  # (F*B, R)
+        sub = jax.lax.broadcasted_iota(jnp.int32, (f_tile * n_bin,
+                                                   r_tile), 0)
+        onehot = (bins_rep == sub % n_bin).astype(jnp.bfloat16)
+        out_ref[0, :, :] += jax.lax.dot_general(
+            onehot, gh_exp, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)
+
+    return kernel
+
+
+def build_onebig(m_pad, r_tile=2048):
+    @jax.jit
+    def fn(binned_t, pos, gh):
+        n_pad = binned_t.shape[1]
+        kernel = make_onebig_kernel(B, m_pad, F)
+        return pl.pallas_call(
+            kernel,
+            grid=(1, 1, n_pad // r_tile),
+            in_specs=[
+                pl.BlockSpec((F, r_tile), lambda mi, fi, ri: (fi, ri)),
+                pl.BlockSpec((r_tile, 1), lambda mi, fi, ri: (ri, 0)),
+                pl.BlockSpec((r_tile, 2), lambda mi, fi, ri: (ri, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, F * B, 2 * m_pad),
+                                   lambda mi, fi, ri: (mi, fi, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, F * B, 2 * m_pad),
+                                           jnp.float32),
+        )(binned_t, pos, gh)
+
+    return fn
+
+
+def main_onebig():
+    rng = np.random.RandomState(0)
+    n_pad = _round_up(N, 8192)
+    binned = jnp.asarray(rng.randint(0, B, (F, n_pad)).astype(np.int32))
+    gh = jnp.asarray(rng.randn(n_pad, 2).astype(np.float32))
+    tot = 0.0
+    for d in range(6):
+        m = 1 << d
+        pos = jnp.asarray(rng.randint(0, m, (n_pad, 1)).astype(np.int32))
+        try:
+            for rt in (1024, 2048):
+                ms = timed(build_onebig(m, rt), binned, pos, gh)
+                print(f"onebig M={m:3d} r{rt}: {ms:6.2f} ms")
+                if rt == 2048:
+                    tot += ms
+        except Exception as e:
+            print(f"onebig M={m}: FAILED {type(e).__name__} {str(e)[:150]}")
+            return
+    pos = jnp.asarray(rng.randint(0, 32, (n_pad, 1)).astype(np.int32))
+    a = build("prod", 32)(binned, pos, gh)
+    b = build_onebig(32)(binned, pos, gh)
+    print(f"onebig total {tot:.1f} ms/round-equiv; match: "
+          f"{bool(jnp.allclose(a, b, atol=1e-3, rtol=1e-3))}")
+
+
+if __name__ == "__main__" and len(sys.argv) > 1 and sys.argv[1] == "onebig":
+    main_onebig()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -285,15 +286,32 @@ class Booster:
                 entry.exact_host = raw_host  # dropped after rank build
                 self._cache[key] = entry
             else:
-                binned = jnp.asarray(bin_matrix(dmat, self.gbtree.cuts))
+                binned_host = bin_matrix(dmat, self.gbtree.cuts)
+                binned = jnp.asarray(binned_host)
                 if self._col_mesh is not None:
                     # pad the feature axis ONCE per matrix (padding per
                     # boosting round would re-copy the whole matrix)
                     from xgboost_tpu.parallel.colsplit import pad_features
                     binned = pad_features(
                         binned, self._col_mesh.devices.size, axis=1)
-                self._cache[key] = _CacheEntry(
+                entry = _CacheEntry(
                     dmat, binned, self._base_margin_of(dmat, dmat.num_row))
+                from xgboost_tpu.ops.histogram import _impl
+                if (self._mesh is None and self._col_mesh is None
+                        and _impl(self.param.hist_precision
+                                  ).startswith("pallas")):
+                    # resident pre-transposed histogram operand (zero
+                    # per-round transpose/layout-copy cost; see
+                    # pallas_hist.host_transpose_bins) — single-chip
+                    # pallas path only: sharded paths re-transpose and
+                    # the scatter fallback never reads it
+                    from xgboost_tpu.ops.pallas_hist import \
+                        host_transpose_bins
+                    bt = host_transpose_bins(binned_host,
+                                             self.gbtree.cfg.n_bin)
+                    entry.binned_t = None if bt is None \
+                        else jnp.asarray(bt)
+                self._cache[key] = entry
             self._attach_root(self._cache[key], dmat)
         entry = self._cache[key]
         if (entry.info is dmat.info
@@ -657,7 +675,8 @@ class Booster:
             entry.binned, entry.margin, entry.info,
             self.obj.fused_grad(entry.info),
             first_iteration, n_rounds, row_valid=entry.row_valid,
-            mesh=self._mesh)
+            mesh=self._mesh,
+            binned_t=getattr(entry, "binned_t", None))
         entry.applied = self.gbtree.num_trees
 
     def boost(self, dtrain: DMatrix, grad, hess):
@@ -731,7 +750,8 @@ class Booster:
                 root=entry.root,
                 exact_has_missing=getattr(entry, "exact_has_missing",
                                           True),
-                exact_ranks=getattr(entry, "exact_ranks", None))
+                exact_ranks=getattr(entry, "exact_ranks", None),
+                binned_t=getattr(entry, "binned_t", None))
             entry.margin = entry.margin + delta
             entry.applied = self.gbtree.num_trees
         if "refresh" in ups:
@@ -936,11 +956,18 @@ class Booster:
                 runs = auc_compress(p, labels, weights)
                 limit = int(getattr(self.param, "dist_auc_max_runs",
                                     1 << 22))
-                if len(runs) > limit:
+                # the exact-vs-approx decision must be GLOBAL: ranks
+                # branching on shard-local run counts would execute
+                # mismatched collectives (allsum vs allgatherv) and
+                # hang — decide on the summed run count, which is also
+                # the actual gathered payload
+                total_runs = int(dmat.allsum(
+                    np.array([float(len(runs))]))[0])
+                if total_runs > limit:
                     if not getattr(self, "_warned_auc_runs", False):
                         self._warned_auc_runs = True
-                        print(f"[dist-auc] {len(runs)} distinct-value "
-                              f"runs on this shard exceeds "
+                        print(f"[dist-auc] {total_runs} distinct-value "
+                              f"runs across shards exceeds "
                               f"dist_auc_max_runs={limit}; falling "
                               "back to the reference's approximate "
                               "mean-of-shards AUC", file=sys.stderr)

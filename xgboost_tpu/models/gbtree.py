@@ -78,7 +78,8 @@ def _vmapped_deltas(stacked, row_leafs, row_valid, K: int, npar: int,
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "K", "npar", "cfg", "split_finder", "grad_fn", "mesh"))
 def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
-                 cut_values, n_cuts, row_valid, *, n_rounds: int, K: int,
+                 cut_values, n_cuts, row_valid, binned_t=None, *,
+                 n_rounds: int, K: int,
                  npar: int, cfg: GrowConfig, split_finder, grad_fn, mesh):
     """``lax.scan`` over whole boosting rounds (one device launch for
     n_rounds x K x npar trees).  Module-level so the jit cache is shared
@@ -100,7 +101,7 @@ def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
         else:
             tree, row_leaf = grow_tree(
                 tkey, binned, gh2, cut_values, n_cuts, cfg, row_valid,
-                split_finder=split_finder)
+                split_finder=split_finder, binned_t=binned_t)
             d = table_lookup(tree.leaf_value, row_leaf)
         if row_valid is not None:
             d = d * row_valid.astype(d.dtype)
@@ -213,7 +214,8 @@ class GBTree:
                  mesh=None, col_mesh=None,
                  root: Optional[jax.Array] = None,
                  exact_has_missing: bool = True,
-                 exact_ranks=None
+                 exact_ranks=None,
+                 binned_t: Optional[jax.Array] = None
                  ) -> Tuple[List[TreeArrays], jax.Array]:
         """One boosting round: grows num_output_group × num_parallel_tree
         trees (reference BoostNewTrees, gbtree-inl.hpp:238-273), then runs
@@ -293,7 +295,8 @@ class GBTree:
                     tree, row_leaf = grow_tree(
                         tkey, binned, gh[:, k, :], self.cut_values_dev,
                         self.n_cuts_dev, self.cfg, row_valid,
-                        split_finder=self._split_finder(), root=root)
+                        split_finder=self._split_finder(), root=root,
+                        binned_t=binned_t)
                     d = None
                 if do_prune:
                     tree, resolve = prune_tree(tree, self.param.gamma,
@@ -434,7 +437,7 @@ class GBTree:
     # ------------------------------------------------------------ fused boost
     def do_boost_fused(self, binned, margin, info, grad_fn,
                        first_iteration: int, n_rounds: int,
-                       row_valid=None, mesh=None):
+                       row_valid=None, mesh=None, binned_t=None):
         """Scan ``n_rounds`` whole boosting rounds in ONE device launch.
 
         Per-round host dispatch (gradient launch + growth launch + margin
@@ -472,7 +475,7 @@ class GBTree:
             binned, margin, label, weight,
             jax.random.PRNGKey(self.param.seed),
             jnp.int32(first_iteration), self.cut_values_dev,
-            self.n_cuts_dev, row_valid,
+            self.n_cuts_dev, row_valid, binned_t,
             n_rounds=n_rounds, K=K, npar=npar, cfg=self.cfg,
             split_finder=self._split_finder(), grad_fn=grad_fn, mesh=mesh)
         # flatten (n_rounds, K*npar, ...) -> (T_new, ...) and install the

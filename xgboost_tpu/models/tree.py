@@ -253,7 +253,8 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
               row_valid: Optional[jax.Array] = None,
               hist_reduce: Callable[[jax.Array], jax.Array] = None,
               split_finder=None, router=None, feat_sampler=None,
-              root: Optional[jax.Array] = None):
+              root: Optional[jax.Array] = None,
+              binned_t: Optional[jax.Array] = None):
     """Grow one tree level-by-level.
 
     Args:
@@ -317,6 +318,17 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
     hist_prev = None
     prev = None  # (best, nst, do_split) of the previous level
 
+    # once-per-tree histogram precompute: the bins transpose and (int8
+    # mode) gradient quantization hoisted out of the level loop —
+    # re-materializing them per level cost ~9 ms/round at 1M x 28
+    # (round-4 trace; ops/histogram.prepare_hist).  binned_t, when the
+    # caller provides it (learner entries), is the RESIDENT
+    # pre-transposed u8 operand: zero per-round transpose AND none of
+    # the per-pallas-call layout copies an in-graph transpose incurs
+    from xgboost_tpu.ops.histogram import prepare_hist
+    hist_prep = prepare_hist(binned, gh_used, cfg.n_bin,
+                             cfg.hist_precision, binned_t=binned_t)
+
     for depth in range(d0, d0 + D + 1):
         n_node = 1 << depth
         base = n_node - 1  # global index of first node at this level
@@ -348,7 +360,8 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
             else:
                 hist = red(build_level_histogram(binned, gh_used, pos,
                                                  n_node, cfg.n_bin,
-                                                 cfg.hist_precision))
+                                                 cfg.hist_precision,
+                                                 prep=hist_prep))
             hist_prev = hist if cfg.hist_subtraction else None
             # node totals fall out of the histogram (bin sums of any one
             # feature) — saves a per-level pass over all rows

@@ -47,13 +47,20 @@ def _hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
                  n_bin: int, m_pad: int, f_tile: int, precision_mode: str):
     """One (node_tile, feature_tile, row_tile) grid step.
 
-    binned_ref: (f_tile, R) int32 bin ids, feature-major
-    pos_ref:    (R, 1) int32 node position (-1 = inactive)
-    gh_ref:     (R, 2) f32 grad/hess
-    out_ref:    (f_tile * n_bin, 2 * m_pad) f32 accumulator for the
-                m_pad nodes of THIS node tile (grid dim 0) — deep levels
+    binned_ref: (f_tile, R) u8|int32 bin ids, feature-major
+    pos_ref:    (1, R) int32 node position (-1 = inactive)
+    gh_ref:     (2, R) f32|int32 grad/hess
+    out_ref:    (f_tile * n_bin, 2 * m_pad) accumulator for the m_pad
+                nodes of THIS node tile (grid dim 0) — deep levels
                 (n_node > m_pad) tile the node dim so the block never
                 outgrows VMEM.
+
+    EVERY per-row operand keeps rows in the LANE dim: TPU arrays tile
+    to (8, 128), so (N, 1)/(N, 2) operands are physically inflated
+    128x/64x — the per-level reshape copies of the old (R, 1) pos
+    alone cost ~5 ms/round at 1M rows (round-4 trace).  gh_exp is
+    therefore built (2M, R) and the dot contracts both operands' lane
+    dim (the natural NT matmul).
     """
     r_tile = binned_ref.shape[1]
     m2 = 2 * m_pad
@@ -63,38 +70,120 @@ def _hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    pos = pos_ref[:, 0]
-    # gh_exp[r, l] = gh[r, l // m_pad] masked by (pos[r] == l % m_pad);
-    # built with broadcast selects (no lane concat, no relayout).
-    lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, m2), 1)
-    node_of_lane = m_base + jnp.where(lane < m_pad, lane, lane - m_pad)
-    g = gh_ref[:, 0:1]
-    h = gh_ref[:, 1:2]
-    ghsel = jnp.where(lane < m_pad, g, h)                    # (R, 2M)
-    active = (pos[:, None] == node_of_lane)                  # (R, 2M)
-    gh_exp = jnp.where(active, ghsel, 0.0)
+    pos = pos_ref[0:1, :]                                    # (1, R)
+    # gh_exp[l, r] = gh[l // m_pad, r] masked by (pos[r] == l % m_pad)
+    sub = jax.lax.broadcasted_iota(jnp.int32, (m2, r_tile), 0)
+    node_of_sub = m_base + jnp.where(sub < m_pad, sub, sub - m_pad)
+    ghsel = jnp.where(sub < m_pad, gh_ref[0:1, :], gh_ref[1:2, :])
+    active = (pos == node_of_sub)                            # (2M, R)
 
     # TPU matmul default precision truncates f32 operands to bf16; fp32
     # mode must request HIGHEST for exact (parity-testable) histograms.
     # In bf16 mode, materialize the operands in bf16 up front: the MXU
     # would truncate them anyway, and halving the one-hot's VMEM
     # footprint is a measured ~20% kernel win (tools/hist_microbench.py).
-    if precision_mode == "fp32":
+    # int8 mode (gh arrives PRE-QUANTIZED as int32, one-hot is int8,
+    # products accumulate exactly in int32): the v5e MXU runs int8 at
+    # 2x the bf16 rate with half the operand bytes — measured ~9x on
+    # the kernel, 0.55 vs ~4.7 ms/level (tools/hist_int8_proto.py).
+    if precision_mode == "int8":
+        gh_exp = jnp.where(active, ghsel, 0).astype(jnp.int8)
+        prec = jax.lax.Precision.DEFAULT
+        hot_dtype = jnp.int8
+        acc_dtype = jnp.int32
+    elif precision_mode == "fp32":
+        gh_exp = jnp.where(active, ghsel, 0.0)
         prec = jax.lax.Precision.HIGHEST  # HIGH: unsupported by Mosaic
         hot_dtype = jnp.float32
+        acc_dtype = jnp.float32
     else:
+        gh_exp = jnp.where(active, ghsel, 0.0).astype(jnp.bfloat16)
         prec = jax.lax.Precision.DEFAULT
         hot_dtype = jnp.bfloat16
-        gh_exp = gh_exp.astype(hot_dtype)
-    bins = binned_ref[:]                                     # (f_tile, R)
+        acc_dtype = jnp.float32
+    # bins may arrive u8 (the entry's resident pre-transposed operand —
+    # zero per-round transpose/layout-copy cost) or int32 (the
+    # in-graph transpose fallback); widen in-register either way
+    bins = binned_ref[:].astype(jnp.int32)                   # (f_tile, R)
     bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_bin, r_tile), 0)
     for f in range(f_tile):
         onehot = (bins[f:f + 1, :] == bin_ids).astype(hot_dtype)  # (B, R)
         acc = jax.lax.dot_general(
-            onehot, gh_exp, (((1,), (0,)), ((), ())),
+            onehot, gh_exp, (((1,), (1,)), ((), ())),
             precision=prec,
-            preferred_element_type=jnp.float32)              # (B, 2M)
+            preferred_element_type=acc_dtype)                # (B, 2M)
         out_ref[0, f * n_bin:(f + 1) * n_bin, :] += acc
+
+
+def resolve_precision(precision: str, n_rows: int) -> str:
+    """int8 needs int32-safe cell accumulators (N * 127 < 2^31)."""
+    if precision == "int8" and n_rows * 127 >= 2 ** 31:
+        return "bf16"
+    return precision
+
+
+def _tiling(N: int, F: int, n_bin: int):
+    """(r_tile, f_tile, n_pad, f_pad) — level-independent (f_tile's
+    lane bound max(2M, 128) = 128 for every m_pad <= 64)."""
+    # read at trace time: changing it after the first same-shape call
+    # has no effect (jit cache) — set it before the first training
+    # round.  2048 measured best on v5e at 1M x 28
+    # (tools/hist_microbench.py); >= 8192 fails Mosaic compilation.
+    r_tile = int(os.environ.get("XGBTPU_HIST_RTILE", "2048"))
+    # feature tile sized so the output block (f_tile*B, 2M) f32 stays
+    # ~<=1MB of VMEM
+    f_tile = max(1, min(F, (256 * 1024) // (max(n_bin, 1) * 128)))
+    # TPU tile rule: a block's sublane dim must be a multiple of 8 OR
+    # equal the full array dim
+    if f_tile < F:
+        f_tile = max(8, (f_tile // 8) * 8)
+    return (r_tile, f_tile, _round_up(max(N, 1), r_tile),
+            _round_up(F, f_tile))
+
+
+def quantize_gh(gh: jax.Array) -> tuple:
+    """Symmetric per-channel int8 quantization of (..., N, 2) grad/hess
+    (batched leading axes quantize per slice): (gh_q int32, scale f32).
+    Quantize ONCE per round — g is fixed within a round; int8 products
+    accumulate exactly in int32 so this is the only error source
+    (~scale/254 per element, vs bf16's ~0.2% relative truncation)."""
+    scale = jnp.maximum(jnp.max(jnp.abs(gh), axis=-2), 1e-30)
+    gh_q = jnp.clip(jnp.round(gh / scale[..., None, :] * 127.0),
+                    -127, 127).astype(jnp.int32)
+    return gh_q, scale
+
+
+def host_transpose_bins(binned_host, n_bin: int):
+    """HOST-side (F, n_pad) u8 pre-transpose — built once per dataset
+    and kept device-resident (standard layout) so the kernel pays zero
+    per-round transpose and none of the per-pallas-call layout copies
+    the in-graph transpose incurs (~7 ms/round at 1M x 28, round-4
+    trace).  Returns None when the feature dim would be tiled (u8
+    sublane tiles need 32-multiples; only the full-dim case is
+    supported — F <= f_tile, true for the default bin counts)."""
+    import numpy as np
+    N, F = binned_host.shape
+    r_tile, f_tile, n_pad, f_pad = _tiling(N, F, n_bin)
+    if f_tile != F or n_bin > 256:
+        # u8 can't hold >256 bin ids (binning emits uint16 there), and
+        # a tiled feature dim would break the u8 (32, 128) tile rule
+        return None
+    bt = np.zeros((F, n_pad), np.uint8)
+    bt[:, :N] = np.asarray(binned_host, np.uint8).T
+    return bt
+
+
+def transpose_bins(binned: jax.Array, n_bin: int) -> jax.Array:
+    """(N, F) bins -> the kernel's padded (f_pad, n_pad) int32 operand.
+    Compute ONCE per tree: left per level, XLA re-materializes the
+    112 MB transpose+pad inside the fused round scan every level
+    (measured ~7 ms/round of copies at 1M x 28 — round-4 trace)."""
+    N, F = binned.shape
+    r_tile, f_tile, n_pad, f_pad = _tiling(N, F, n_bin)
+    binned_t = binned.astype(jnp.int32).T
+    if n_pad != N or f_pad != F:
+        binned_t = jnp.pad(binned_t, ((0, f_pad - F), (0, n_pad - N)))
+    return binned_t
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -105,41 +194,43 @@ def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
                                  interpret: bool = False) -> jax.Array:
     """Pallas drop-in for ``histogram.build_level_histogram``.
 
-    Args match the XLA version; ``precision`` selects the MXU pass count:
-    "fp32" (HIGHEST, exact f32 — parity-testable against the scatter) or
+    Args match the XLA version; ``precision`` selects the MXU mode:
+    "fp32" (HIGHEST, exact f32 — parity-testable against the scatter),
     "bf16" (DEFAULT, ~3x faster; operands truncated to bf16 inside the
-    MXU, accumulation still f32).
+    MXU, accumulation still f32), or "int8" (gradients quantized per
+    call to 8 bits, int32-exact accumulation, ~9x the bf16 kernel —
+    element error ~s/254 vs bf16's ~0.2% relative truncation).
 
     Returns (n_node, F, n_bin, 2) float32.
     """
     N, F = binned.shape
-    # read at trace time: changing it after the first same-shape call has
-    # no effect (jit cache) — set it before the first training round.
-    # 2048 measured best on v5e at 1M x 28 (tools/hist_microbench.py);
-    # larger tiles hit Mosaic compile failures at 8192+.
-    r_tile = int(os.environ.get("XGBTPU_HIST_RTILE", "2048"))
+    precision = resolve_precision(precision, N)
+    binned_t = transpose_bins(binned, n_bin)
+    if precision == "int8":
+        gh_in, scale = quantize_gh(gh)
+    else:
+        gh_in, scale = gh.astype(jnp.float32), None
+    return _hist_pallas_pre(binned_t, gh_in, scale, pos, (N, F), n_node,
+                            n_bin, precision, interpret)
+
+
+def _hist_pallas_pre(binned_t, gh_in, scale, pos, nf, n_node: int,
+                     n_bin: int, precision: str, interpret: bool
+                     ) -> jax.Array:
+    """Kernel invocation on PREPARED operands (transpose_bins /
+    quantize_gh hoisted to once per tree/round by the grow loop)."""
+    N, F = nf
+    r_tile, f_tile, n_pad, f_pad = _tiling(N, F, n_bin)
     # deep levels tile the node dim at 64 (lane dim 2*64 = one full MXU
     # pass) so the accumulator block stays VMEM-bounded at any depth
     m_pad = min(n_node, 64)
     n_m_tiles = -(-n_node // m_pad)
-    # feature tile sized so the output block (f_tile*B, 2M) f32 stays
-    # ~<=1MB of VMEM
-    f_tile = max(1, min(F, (256 * 1024) // (max(n_bin, 1) *
-                                            max(2 * m_pad, 128))))
-    # TPU tile rule: a block's sublane dim must be a multiple of 8 OR
-    # equal the full array dim.  Tile in multiples of 8 when tiling at
-    # all; otherwise take the whole (un-padded) feature dim.
-    if f_tile < F:
-        f_tile = max(8, (f_tile // 8) * 8)
-    n_pad = _round_up(max(N, 1), r_tile)
-    f_pad = _round_up(F, f_tile)
+    # rows ride the LANE dim of every per-row operand (see _hist_kernel)
+    pos_t = jnp.pad(pos.astype(jnp.int32), (0, n_pad - N),
+                    constant_values=-1)[None, :]             # (1, n_pad)
+    gh_t = jnp.pad(gh_in.T, ((0, 0), (0, n_pad - N)))        # (2, n_pad)
 
-    binned_t = binned.astype(jnp.int32).T                    # (F, N)
-    if n_pad != N or f_pad != F:
-        binned_t = jnp.pad(binned_t, ((0, f_pad - F), (0, n_pad - N)))
-        gh = jnp.pad(gh, ((0, n_pad - N), (0, 0)))
-        pos = jnp.pad(pos, (0, n_pad - N), constant_values=-1)
-
+    out_dtype = jnp.int32 if precision == "int8" else jnp.float32
     kernel = functools.partial(_hist_kernel, n_bin=n_bin, m_pad=m_pad,
                                f_tile=f_tile, precision_mode=precision)
     out = pl.pallas_call(
@@ -147,22 +238,25 @@ def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
         grid=(n_m_tiles, f_pad // f_tile, n_pad // r_tile),
         in_specs=[
             pl.BlockSpec((f_tile, r_tile), lambda mi, fi, ri: (fi, ri)),
-            pl.BlockSpec((r_tile, 1), lambda mi, fi, ri: (ri, 0)),
-            pl.BlockSpec((r_tile, 2), lambda mi, fi, ri: (ri, 0)),
+            pl.BlockSpec((1, r_tile), lambda mi, fi, ri: (0, ri)),
+            pl.BlockSpec((2, r_tile), lambda mi, fi, ri: (0, ri)),
         ],
         out_specs=pl.BlockSpec((1, f_tile * n_bin, 2 * m_pad),
                                lambda mi, fi, ri: (mi, fi, 0)),
         out_shape=jax.ShapeDtypeStruct((n_m_tiles, f_pad * n_bin, 2 * m_pad),
-                                       jnp.float32),
+                                       out_dtype),
         interpret=interpret,
-    )(binned_t, pos.reshape(-1, 1).astype(jnp.int32),
-      gh.astype(jnp.float32))
+    )(binned_t, pos_t, gh_t)
 
     # (m_tiles, f_pad*B, 2M) -> (m_tiles, F, B, 2, M) -> (m_tiles*M, F, B, 2)
     out = out.reshape(n_m_tiles, f_pad, n_bin, 2, m_pad)
     out = out.transpose(0, 4, 1, 2, 3).reshape(
         n_m_tiles * m_pad, f_pad, n_bin, 2)
-    return out[:n_node, :F, :, :]
+    out = out[:n_node, :F, :, :]
+    if precision == "int8":
+        # dequantize the exact int32 sums back to f32 cell values
+        out = out.astype(jnp.float32) * (scale / 127.0)[None, None, None, :]
+    return out
 
 
 def _batched_hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
@@ -180,10 +274,12 @@ def _batched_hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
     block stay VMEM-bounded at any ensemble width (num_parallel_tree
     forests): per step only ``t_tile`` trees' lanes are resident.
 
-    binned_ref: (f_tile, R) int32;  pos_ref: (R, t_tile) int32;
-    gh_ref: (R, 2*t_tile) f32, INTERLEAVED per tree (g_t, h_t pairs) so
-    tree tiles are contiguous lane blocks;
-    out_ref: (1, 1, f_tile*n_bin, t_tile*2*m_pad) f32.
+    binned_ref: (f_tile, R) int32;  pos_ref: (t_tile, R) int32;
+    gh_ref: (2*t_tile, R) f32|int32, per-tree (g_t, h_t) sublane pairs.
+    out_ref: (1, 1, f_tile*n_bin, t_tile*2*m_pad).
+    Rows ride the LANE dim of every per-row operand and gh_exp is
+    (lanes, R) with an NT dot, for the same physical-tiling reason as
+    :func:`_hist_kernel`.
     """
     r_tile = binned_ref.shape[1]
     m2 = 2 * m_pad
@@ -194,41 +290,48 @@ def _batched_hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    lane = jax.lax.broadcasted_iota(jnp.int32, (r_tile, lanes), 1)
-    t_of = lane // m2
-    within = lane - t_of * m2
+    sub = jax.lax.broadcasted_iota(jnp.int32, (lanes, r_tile), 0)
+    t_of = sub // m2
+    within = sub - t_of * m2
     node_of = m_base + jnp.where(within < m_pad, within, within - m_pad)
     is_h = within >= m_pad
 
-    # per-lane gh/pos selected by tree id via t_tile broadcast compares
-    # (tiles are small; dynamic lane gathers would serialize)
-    gh = gh_ref[:]                                   # (R, 2*t_tile)
-    pos = pos_ref[:]                                 # (R, t_tile)
-    ghsel = jnp.zeros((r_tile, lanes), jnp.float32)
-    possel = jnp.zeros((r_tile, lanes), jnp.int32)
+    # per-sublane gh/pos selected by tree id via t_tile broadcast
+    # compares (tiles are small; dynamic gathers would serialize)
+    gh_dtype = jnp.int32 if precision_mode == "int8" else jnp.float32
+    ghsel = jnp.zeros((lanes, r_tile), gh_dtype)
+    possel = jnp.zeros((lanes, r_tile), jnp.int32)
     for t in range(t_tile):
         sel = t_of == t
-        gval = jnp.where(is_h, gh[:, 2 * t + 1:2 * t + 2],
-                         gh[:, 2 * t:2 * t + 1])
+        gval = jnp.where(is_h, gh_ref[2 * t + 1:2 * t + 2, :],
+                         gh_ref[2 * t:2 * t + 1, :])
         ghsel = jnp.where(sel, gval, ghsel)
-        possel = jnp.where(sel, pos[:, t:t + 1], possel)
-    gh_exp = jnp.where(possel == node_of, ghsel, 0.0)
+        possel = jnp.where(sel, pos_ref[t:t + 1, :], possel)
 
-    if precision_mode == "fp32":
+    if precision_mode == "int8":
+        gh_exp = jnp.where(possel == node_of, ghsel, 0).astype(jnp.int8)
+        prec = jax.lax.Precision.DEFAULT
+        hot_dtype = jnp.int8
+        acc_dtype = jnp.int32
+    elif precision_mode == "fp32":
+        gh_exp = jnp.where(possel == node_of, ghsel, 0.0)
         prec = jax.lax.Precision.HIGHEST
         hot_dtype = jnp.float32
+        acc_dtype = jnp.float32
     else:
+        gh_exp = jnp.where(possel == node_of, ghsel,
+                           0.0).astype(jnp.bfloat16)
         prec = jax.lax.Precision.DEFAULT
         hot_dtype = jnp.bfloat16
-        gh_exp = gh_exp.astype(hot_dtype)
+        acc_dtype = jnp.float32
 
-    bins = binned_ref[:]
+    bins = binned_ref[:].astype(jnp.int32)
     bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_bin, r_tile), 0)
     for f in range(f_tile):
         onehot = (bins[f:f + 1, :] == bin_ids).astype(hot_dtype)
         acc = jax.lax.dot_general(
-            onehot, gh_exp, (((1,), (0,)), ((), ())),
-            precision=prec, preferred_element_type=jnp.float32)
+            onehot, gh_exp, (((1,), (1,)), ((), ())),
+            precision=prec, preferred_element_type=acc_dtype)
         out_ref[0, 0, f * n_bin:(f + 1) * n_bin, :] += acc
 
 
@@ -248,23 +351,68 @@ def build_level_histogram_pallas_batched(binned: jax.Array, gh: jax.Array,
     """
     T, N, _ = gh.shape
     F = binned.shape[1]
+    precision = resolve_precision(precision, N)
+    if precision == "int8":
+        gh, scale = quantize_gh(gh)                  # per-tree (T, 2)
+    else:
+        scale = None
+    return _hist_pallas_batched_pre(
+        transpose_bins_batched(binned, n_bin, T, min(n_node, 64),
+                               precision), gh, scale,
+        pos, (N, F), n_node, n_bin, precision, interpret)
+
+
+def _hist_pallas_batched_prequant(binned, gh_in, scale, pos, n_node: int,
+                                  n_bin: int, precision: str,
+                                  interpret: bool) -> jax.Array:
+    """Batched kernel from RAW bins + pre-quantized gradients (the
+    ensemble vmap rule of the prep path: batched tiling depends on the
+    tree count, so the transpose happens here per call)."""
+    T, N, _ = gh_in.shape
+    F = binned.shape[1]
+    return _hist_pallas_batched_pre(
+        transpose_bins_batched(binned, n_bin, T, min(n_node, 64),
+                               precision), gh_in,
+        scale, pos, (N, F), n_node, n_bin, precision, interpret)
+
+
+def transpose_bins_batched(binned, n_bin: int, T: int, m_pad: int,
+                           precision: str):
+    """Padded (f_pad, n_pad) int32 operand for the BATCHED kernel (its
+    r/f tiling depends on the tree count, level and precision)."""
+    N, F = binned.shape
+    r_tile, f_tile, _, n_pad, f_pad, *_ = _tiling_batched(
+        N, F, n_bin, T, m_pad, precision)
+    binned_t = binned.astype(jnp.int32).T
+    if n_pad != N or f_pad != F:
+        binned_t = jnp.pad(binned_t, ((0, f_pad - F), (0, n_pad - N)))
+    return binned_t
+
+
+def _t_tile_of(T, m2, n_bin):
+    """Trees per grid step: t_tile trees give lanes = t_tile*2M and an
+    output block of f_tile*B x lanes f32, both VMEM-bounded at ANY
+    ensemble width (num_parallel_tree forests)."""
+    return max(1, min(T, max(1, 768 // m2),
+                      (2 << 20) // (8 * max(n_bin, 1) * m2 * 4)))
+
+
+def _tiling_batched(N, F, n_bin, T, m_pad, precision):
+    """Per-LEVEL r/f tiling for the batched kernel (the batched path
+    re-transposes its bins per call, so no cross-level layout sharing
+    is needed).  Returns (r_tile, f_tile, t_tile, n_pad, f_pad,
+    lanes)."""
     r_tile = int(os.environ.get("XGBTPU_HIST_RTILE", "2048"))
-    m_pad = min(n_node, 64)
-    n_m_tiles = -(-n_node // m_pad)
     m2 = 2 * m_pad
-    # tile the tree dim so per-step lanes and the output block stay
-    # VMEM-bounded at ANY ensemble width: t_tile trees give lanes =
-    # t_tile*2M and an output block of f_tile*B x lanes f32 (<= ~2MB
-    # with the minimum legal f_tile of 8)
-    t_tile = max(1, min(T, max(1, 768 // m2),
-                        (2 << 20) // (8 * max(n_bin, 1) * m2 * 4)))
-    t_tiles = -(-T // t_tile)
-    T_pad = t_tiles * t_tile
+    t_tile = _t_tile_of(T, m2, n_bin)
     lanes = t_tile * m2
     # the (r_tile, lanes) gh_exp operand: cap at ~3MB of VMEM or Mosaic
-    # fails to place the kernel (seen at fp32, lanes=768, r_tile=2048)
-    esize = 4 if precision == "fp32" else 2
-    r_cap = max(512, ((3 << 20) // (max(lanes, 1) * esize)) // 512 * 512)
+    # fails to place the kernel (seen at fp32, lanes=768, r_tile=2048).
+    # int8 mode's ghsel/possel INTERMEDIATES are int32, so it budgets
+    # like fp32 (scoped-vmem OOM otherwise — seen at 6 trees, B=64)
+    esize = 2 if precision == "bf16" else 4
+    r_cap = max(512, ((3 << 20) // (max(lanes, 128) * esize))
+                // 512 * 512)
     r_tile = min(r_tile, r_cap)
     # f_tile: multiple of 8 (or the whole feature dim), output block
     # f_tile*B x lanes f32 <= ~2MB
@@ -272,45 +420,62 @@ def build_level_histogram_pallas_batched(binned: jax.Array, gh: jax.Array,
                                             max(lanes, 128))))
     if f_tile < F:
         f_tile = max(8, (f_tile // 8) * 8)
-    n_pad = _round_up(max(N, 1), r_tile)
-    f_pad = _round_up(F, f_tile)
+    return (r_tile, f_tile, t_tile, _round_up(max(N, 1), r_tile),
+            _round_up(F, f_tile), lanes)
 
-    binned_t = binned.astype(jnp.int32).T
-    if n_pad != N or f_pad != F or T_pad != T:
-        binned_t = jnp.pad(binned_t, ((0, f_pad - F), (0, n_pad - N)))
+
+def _hist_pallas_batched_pre(binned_t, gh, scale, pos, nf, n_node: int,
+                             n_bin: int, precision: str,
+                             interpret: bool) -> jax.Array:
+    N, F = nf
+    T = gh.shape[0]
+    m_pad = min(n_node, 64)
+    n_m_tiles = -(-n_node // m_pad)
+    m2 = 2 * m_pad
+    r_tile, f_tile, t_tile, n_pad, f_pad, lanes = _tiling_batched(
+        N, F, n_bin, T, m_pad, precision)
+    t_tiles = -(-T // t_tile)
+    T_pad = t_tiles * t_tile
+    if n_pad != N or T_pad != T:
         gh = jnp.pad(gh, ((0, T_pad - T), (0, n_pad - N), (0, 0)))
         pos = jnp.pad(pos, ((0, T_pad - T), (0, n_pad - N)),
                       constant_values=-1)
 
-    # interleaved per-tree (g, h) lane pairs so a t_tile block is one
-    # contiguous lane slice: (T, N, 2) -> (N, 2T)
-    gh_flat = gh.transpose(1, 0, 2).reshape(n_pad, 2 * T_pad)
-    pos_t = pos.T.astype(jnp.int32)                  # (N, T_pad)
+    # per-tree (g, h) SUBLANE pairs, rows in lanes (see _hist_kernel's
+    # physical-tiling note): (T, N, 2) -> (2T, N)
+    gh_flat = gh.transpose(0, 2, 1).reshape(2 * T_pad, n_pad)
+    pos_t = pos.astype(jnp.int32)                    # (T_pad, N)
 
     kernel = functools.partial(_batched_hist_kernel, n_bin=n_bin,
                                m_pad=m_pad, f_tile=f_tile, t_tile=t_tile,
                                precision_mode=precision)
+    out_dtype = jnp.int32 if precision == "int8" else jnp.float32
     out = pl.pallas_call(
         kernel,
         grid=(n_m_tiles, t_tiles, f_pad // f_tile, n_pad // r_tile),
         in_specs=[
             pl.BlockSpec((f_tile, r_tile), lambda mi, ti, fi, ri: (fi, ri)),
-            pl.BlockSpec((r_tile, t_tile), lambda mi, ti, fi, ri: (ri, ti)),
-            pl.BlockSpec((r_tile, 2 * t_tile),
-                         lambda mi, ti, fi, ri: (ri, ti)),
+            pl.BlockSpec((t_tile, r_tile), lambda mi, ti, fi, ri: (ti, ri)),
+            pl.BlockSpec((2 * t_tile, r_tile),
+                         lambda mi, ti, fi, ri: (ti, ri)),
         ],
         out_specs=pl.BlockSpec((1, 1, f_tile * n_bin, lanes),
                                lambda mi, ti, fi, ri: (mi, ti, fi, 0)),
         out_shape=jax.ShapeDtypeStruct(
-            (n_m_tiles, t_tiles, f_pad * n_bin, lanes), jnp.float32),
+            (n_m_tiles, t_tiles, f_pad * n_bin, lanes), out_dtype),
         interpret=interpret,
-    )(binned_t, pos_t, gh_flat.astype(jnp.float32))
+    )(binned_t, pos_t,
+      gh_flat if precision == "int8" else gh_flat.astype(jnp.float32))
 
     # (m_tiles, t_tiles, f_pad*B, t_tile*2M) -> (T, m_tiles*M, F, B, 2)
     out = out.reshape(n_m_tiles, t_tiles, f_pad, n_bin, t_tile, 2, m_pad)
     out = out.transpose(1, 4, 0, 6, 2, 3, 5).reshape(
         T_pad, n_m_tiles * m_pad, f_pad, n_bin, 2)
-    return out[:T, :n_node, :F, :, :]
+    out = out[:T, :n_node, :F, :, :]
+    if precision == "int8":
+        out = (out.astype(jnp.float32)
+               * (scale / 127.0)[:, None, None, None, :])
+    return out
 
 
 def _nst_kernel(pos_ref, gh_ref, out_ref, *, m_pad: int):

@@ -58,10 +58,11 @@ def hlo_collectives(hlo_text: str) -> List[Tuple[str, str, int]]:
     dump (``jax.jit(f).lower(...).compile().as_text()``).
 
     Async pairs: a ``-start`` tuple result holds (operand-alias,
-    produced buffer[, u32[] context scalars...]); the payload is the
-    LARGEST element — equal to the buffer for all-reduce /
-    collective-permute and the (bigger) result for all-gather, and
-    never a trailing context scalar.  ``-done`` ops carry none."""
+    produced buffer[, u32[] context scalars...]); context scalars are
+    dropped, then the payload is the produced buffer: the LARGEST
+    remaining element for all-reduce / collective-permute /
+    all-gather, but the SMALLEST for reduce-scatter (its result is
+    1/n_shards of the operand).  ``-done`` ops carry none."""
     out = []
     for line in hlo_text.splitlines():
         m = _COLL_RE.match(line)
@@ -73,7 +74,8 @@ def hlo_collectives(hlo_text: str) -> List[Tuple[str, str, int]]:
             continue
         sizes = [_one_shape_bytes(t, d) for t, d in parsed]
         if start and shapes.startswith("("):
-            payload = max(sizes)
+            real = [s for s in sizes if s > 8] or sizes
+            payload = min(real) if op == "reduce-scatter" else max(real)
         else:
             payload = sum(sizes)
         out.append((op, shapes.strip(), payload))
