@@ -1,0 +1,210 @@
+"""Group-padded rank layout (round 4, rank_device.rank_gradient_padded).
+
+The learner relays ranking entries group-padded (label-sorted rows,
+lane padding per group) so the LambdaRank gradient runs sort-free and
+gather-free.  These tests pin the layout invariants, the user-row
+unmapping of every output surface, and trained-metric parity against
+the sort-based device path (reference semantics:
+src/learner/objective-inl.hpp:274-570).
+"""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.rank_device import build_pad_prep, build_prep
+
+
+def make_ragged(seed=0, n_groups=50, lo=5, hi=60):
+    rng = np.random.RandomState(seed)
+    rows, labels, groups = [], [], []
+    for _ in range(n_groups):
+        n = rng.randint(lo, hi)
+        Xg = rng.rand(n, 6).astype(np.float32)
+        score = Xg[:, 0] * 2 + Xg[:, 1] - 0.5 * Xg[:, 2] \
+            + 0.3 * rng.randn(n)
+        rel = np.zeros(n, np.int32)
+        order = np.argsort(-score)
+        rel[order[: max(1, n // 6)]] = 2
+        rel[order[max(1, n // 6): max(2, n // 3)]] = 1
+        rows.append(Xg)
+        labels.append(rel)
+        groups.append(n)
+    return (np.concatenate(rows), np.concatenate(labels).astype(np.float32),
+            np.array(groups))
+
+
+def test_pad_prep_invariants():
+    X, y, groups = make_ragged(seed=3)
+    gptr = np.concatenate([[0], np.cumsum(groups)])
+    prep = build_pad_prep(y, gptr)
+    G, L = prep.G, prep.L
+    assert G == len(groups)
+    assert L >= groups.max() and L % 8 == 0
+    # pad_map / user_map are inverse on occupied slots
+    occ = prep.pad_map >= 0
+    assert occ.sum() == len(y)
+    assert np.array_equal(prep.user_map[prep.pad_map[occ]],
+                          np.nonzero(occ)[0])
+    lab = np.asarray(prep.label)
+    valid = np.asarray(prep.valid)
+    for g in range(G):
+        sz = groups[g]
+        assert valid[g, :sz].all() and not valid[g, sz:].any()
+        # labels descending within the group's lanes
+        assert (np.diff(lab[g, :sz]) <= 0).all()
+        # slot rows really belong to group g
+        slot_rows = prep.pad_map[g * L: g * L + sz]
+        assert ((slot_rows >= gptr[g]) & (slot_rows < gptr[g + 1])).all()
+        # bucket bounds delimit equal-label runs
+        b_lo = np.asarray(prep.b_lo)[g, :sz]
+        b_sz = np.asarray(prep.b_sz)[g, :sz]
+        for j in range(sz):
+            blk = lab[g, b_lo[j]: b_lo[j] + b_sz[j]]
+            assert (blk == lab[g, j]).all()
+            if b_lo[j] > 0:
+                assert lab[g, b_lo[j] - 1] != lab[g, j]
+    # idcg matches the flat prep's
+    flat = build_prep(y, gptr, len(y))
+    np.testing.assert_allclose(
+        np.asarray(prep.idcg)[:, 0],
+        np.asarray(flat.idcg)[gptr[:-1]], rtol=1e-6)
+
+
+def test_padded_entry_selected_and_unmapped():
+    """The padded entry activates for device-rank training, and every
+    user-facing surface (predict, pred_leaf, eval) returns USER row
+    order — pinned by comparing against the same model applied to an
+    uncached (plain-layout) copy of the data."""
+    X, y, groups = make_ragged(seed=1)
+    d = xgb.DMatrix(X, label=y, group=groups)
+    bst = xgb.train({"objective": "rank:ndcg", "max_depth": 4, "eta": 0.3},
+                    d, 8, verbose_eval=False)
+    entry = bst._cache[id(d)]
+    assert entry.rank_pad_prep is not None
+    assert entry.binned.shape[0] == (entry.rank_pad_prep.G
+                                     * entry.rank_pad_prep.L
+                                     + entry.rank_pad_prep.n_tail)
+
+    d2 = xgb.DMatrix(X, label=y, group=groups)  # uncached -> plain layout
+    p_cached = bst.predict(d)
+    p_fresh = bst.predict(d2)
+    np.testing.assert_allclose(p_cached, p_fresh, rtol=1e-5, atol=1e-6)
+
+    l_cached = bst.predict(d, pred_leaf=True)
+    l_fresh = bst.predict(d2, pred_leaf=True)
+    assert np.array_equal(l_cached, l_fresh)
+
+    # eval through the padded entry == eval through a plain entry
+    e_cached = bst.eval(d, "x")
+    e_fresh = bst.eval(d2, "x")
+    assert e_cached.split("\t")[1:] == e_fresh.split("\t")[1:]
+
+
+def test_padded_boost_identical_trees():
+    """boost() with user gradients scatters them into padded slots;
+    padding rows carry zero gradient, so the grown trees match the
+    plain layout's exactly."""
+    X, y, groups = make_ragged(seed=2)
+    rng = np.random.RandomState(0)
+    g = rng.randn(len(y)).astype(np.float32)
+    h = np.abs(rng.randn(len(y))).astype(np.float32) + 0.1
+
+    import os
+    preds = {}
+    for pad in ("1", "0"):
+        os.environ["XGBTPU_RANK_PAD"] = pad
+        try:
+            d = xgb.DMatrix(X, label=y, group=groups)
+            bst = xgb.Booster({"objective": "rank:ndcg", "max_depth": 4,
+                               "eta": 0.5}, cache=[d])
+            bst.boost(d, g, h)
+            bst.boost(d, g * 0.5, h)
+            preds[pad] = bst.predict(d, output_margin=True)
+        finally:
+            os.environ.pop("XGBTPU_RANK_PAD", None)
+    assert (bst._cache[id(d)].rank_pad_prep is None)  # pad=0 disabled it
+    np.testing.assert_allclose(preds["1"], preds["0"],
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("kind,floor", [
+    ("pairwise", 0.85), ("ndcg", 0.85), ("map", 0.85)])
+def test_padded_matches_sort_path_quality(kind, floor):
+    """Padded vs sort-based device gradients: different pair-sampling
+    draws, same expected gradient — trained metrics must agree."""
+    import os
+    X, y, groups = make_ragged(seed=4)
+    res = {}
+    for pad in ("1", "0"):
+        os.environ["XGBTPU_RANK_PAD"] = pad
+        try:
+            d = xgb.DMatrix(X, label=y, group=groups)
+            r = {}
+            xgb.train({"objective": f"rank:{kind}", "max_depth": 4,
+                       "eta": 0.3, "eval_metric": ["ndcg"]},
+                      d, 10, evals=[(d, "train")], evals_result=r,
+                      verbose_eval=False)
+            res[pad] = r["train-ndcg"][-1]
+        finally:
+            os.environ.pop("XGBTPU_RANK_PAD", None)
+    assert res["1"] > floor, (kind, res)
+    assert abs(res["1"] - res["0"]) < 0.05, (kind, res)
+
+
+def test_padded_fused_scan_path():
+    """No-evals training takes update_many's fused path with the padded
+    gradient closure; set_group afterwards rebuilds the layout."""
+    X, y, groups = make_ragged(seed=5)
+    d = xgb.DMatrix(X, label=y, group=groups)
+    bst = xgb.Booster({"objective": "rank:ndcg", "max_depth": 3,
+                       "eta": 0.3}, cache=[d])
+    bst.update(d, 0)
+    entry = bst._cache[id(d)]
+    prep = entry.rank_pad_prep
+    assert prep is not None
+    assert bst.obj.fused_grad(d.info, pad_prep=prep) is not None
+    bst.update_many(d, 1, 5)
+    p = bst.predict(d)
+    assert p.shape == (len(y),)
+    ndcg = float(bst.eval(d, "t").split(":")[-1])
+    assert np.isfinite(ndcg)
+
+    # layout invalidation: changing groups rebuilds the entry
+    g2 = groups.copy()
+    g2[0] -= 1
+    g2[1] += 1
+    d.set_group(g2)
+    bst.update(d, 6)
+    assert bst._cache[id(d)].rank_pad_prep is not prep
+
+
+def test_padded_entry_invalidated_on_objective_switch():
+    """set_param to a non-rank objective (or rank_impl=host) after
+    padded training must rebuild a plain entry — the padded layout is
+    meaningful only to the device rank gradient."""
+    X, y, groups = make_ragged(seed=6, n_groups=20)
+    d = xgb.DMatrix(X, label=y, group=groups)
+    bst = xgb.Booster({"objective": "rank:ndcg", "max_depth": 3,
+                       "eta": 0.3}, cache=[d])
+    bst.update(d, 0)
+    assert bst._cache[id(d)].rank_pad_prep is not None
+    bst.set_param("rank_impl", "host")
+    bst.update(d, 1)
+    assert bst._cache[id(d)].rank_pad_prep is None
+    p = bst.predict(d)
+    assert p.shape == (len(y),)
+
+
+def test_padded_gate_declines_large_lanes():
+    """A single huge group exceeds the lane cap -> sort path."""
+    import os
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = rng.rand(n, 4).astype(np.float32)
+    y = (rng.rand(n) * 3).astype(np.int32).astype(np.float32)
+    d = xgb.DMatrix(X, label=y, group=[n])  # one group of 2000 > 256 lanes
+    bst = xgb.Booster({"objective": "rank:ndcg", "max_depth": 3}, cache=[d])
+    bst.update(d, 0)
+    assert bst._cache[id(d)].rank_pad_prep is None
+    assert os.environ.get("XGBTPU_RANK_PAD") is None

@@ -50,6 +50,18 @@ class _CacheEntry:
         self.external = external         # paged matrix: margin lives on host
         self.root: Optional[jax.Array] = None  # per-row root slots (N_pad,)
         self.info_version = dmat.info.version  # source-snapshot tracking
+        # group-padded rank layout (rank_device.PadRankPrep): rows are
+        # RELAID (label-sorted, lane-padded per group), so user-facing
+        # outputs must unmap via user_rows() instead of [:n_real]
+        self.rank_pad_prep = None
+
+    def user_rows(self, x):
+        """User-row view of a host per-row array (rows = first axis):
+        a [:n_real] slice for end-padded layouts, the static unmap
+        gather for group-padded rank entries."""
+        if self.rank_pad_prep is not None:
+            return x[self.rank_pad_prep.user_map]
+        return x[:self.n_real]
 
 
 class Booster:
@@ -247,6 +259,17 @@ class Booster:
             # after caching must rebuild the snapshot (stale device labels
             # would silently feed the gradients otherwise)
             del self._cache[key]
+        if (key in self._cache
+                and self._cache[key].rank_pad_prep is not None
+                and (self._cache[key].info_version != dmat.info.version
+                     or self.obj is None
+                     or not self.param.objective.startswith("rank:")
+                     or getattr(self.obj, "rank_impl", None) != "device")):
+            # the group-padded rank layout is DERIVED from labels +
+            # group_ptr (any set_field invalidates the relayout) and
+            # only the device rank gradient understands it (a set_param
+            # switching objective/rank_impl must rebuild a plain entry)
+            del self._cache[key]
         if key not in self._cache:
             if self.num_feature and dmat.num_col > self.num_feature:
                 raise ValueError(
@@ -285,6 +308,8 @@ class Booster:
                 entry.exact_ranks = None  # built lazily on first boost
                 entry.exact_host = raw_host  # dropped after rank build
                 self._cache[key] = entry
+            elif self._rank_pad_ok(dmat):
+                self._cache[key] = self._make_rank_padded_entry(dmat)
             else:
                 binned_host = bin_matrix(dmat, self.gbtree.cuts)
                 binned = jnp.asarray(binned_host)
@@ -480,6 +505,88 @@ class Booster:
                             row_valid=row_valid, n_real=dmat.global_num_row)
         return entry
 
+    def _rank_pad_ok(self, dmat) -> bool:
+        """Gate for the group-padded rank layout (rank_device round 4):
+        device LambdaRank, single chip, in-memory gbtree, grouped data
+        with modest group sizes and small integer labels (bf16-exact in
+        the one-hot partner dot).  ``XGBTPU_RANK_PAD=0`` disables."""
+        info = dmat.info
+        if (os.environ.get("XGBTPU_RANK_PAD", "1") == "0"
+                or self.obj is None
+                or not self.param.objective.startswith("rank:")
+                or getattr(self.obj, "rank_impl", None) != "device"
+                or self._col_mesh is not None
+                or self._K != 1
+                or info.group_ptr is None or len(info.group_ptr) < 2
+                or info.label is None
+                or (getattr(info, "root_index", None) is not None
+                    and max(1, self.param.num_roots) > 1)):
+            return False
+        gptr = np.asarray(info.group_ptr, np.int64)
+        sizes = np.diff(gptr)
+        if len(sizes) == 0 or sizes.min() <= 0:
+            return False
+        G = len(sizes)
+        L = max(8, int(-(-sizes.max() // 8) * 8))
+        n = dmat.num_row
+        # clamped at 256: lane positions/counts up to L must stay exact
+        # in the bf16 one-hot partner dot (256 = 2^8 is the last exact
+        # odd-step integer; see rank_device._lane_select)
+        max_lane = min(256, int(os.environ.get("XGBTPU_RANK_PAD_MAXLANE",
+                                               "256")))
+        la = np.asarray(info.label)
+        # padding blow-up economics: extra rows cost grower time
+        # (~14 ms per 1M-row round) against the ~7.7 ms/1M the padded
+        # gradient saves (tools/rank_inv_ab.py) — breakeven ~1.45x.
+        # Small datasets take the padded path more liberally (absolute
+        # cost is negligible; one code path to exercise).
+        blow = (G * L + (n - int(gptr[-1]))) / max(n, 1)
+        return (L <= max_lane
+                and G * L * L <= (1 << 28)       # (G, L, L) plane budget
+                and (blow <= 1.4 or (n <= 200_000 and blow <= 3.0))
+                and bool(np.all(la >= 0)) and bool(np.all(la < 32))
+                and bool(np.all(la == np.round(la))))
+
+    def _make_rank_padded_entry(self, dmat) -> _CacheEntry:
+        """Entry in the group-padded rank layout: group g owns slots
+        [g*L, (g+1)*L), rows label-sorted within the group (the
+        reference's bucket-skipping partner draw becomes a pure lane
+        formula), padding slots carry bin 0 / zero gradients.  The
+        per-round LambdaRank gradient then runs sort-free and
+        gather-free (rank_device.rank_gradient_padded; measured 3.2 vs
+        10.9 ms at 1M rows / 10k groups — tools/rank_inv_ab.py)."""
+        from xgboost_tpu.rank_device import build_pad_prep
+        info = dmat.info
+        tag = ("rank_pad_prep",)
+        if tag not in info._dev_cache:
+            info._dev_cache[tag] = build_pad_prep(
+                np.asarray(info.label, np.float32),
+                np.asarray(info.group_ptr, np.int64))
+        prep = info._dev_cache[tag]
+        n_slots = prep.G * prep.L + prep.n_tail
+        occupied = prep.pad_map >= 0                      # (n_slots,)
+        src = prep.pad_map[occupied]
+
+        binned_host = bin_matrix(dmat, self.gbtree.cuts)
+        binned_pad = np.zeros((n_slots, binned_host.shape[1]),
+                              binned_host.dtype)
+        binned_pad[occupied] = binned_host[src]
+        base = np.asarray(self._base_margin_of(dmat, dmat.num_row))
+        base_pad = np.full((n_slots, self._K),
+                           float(base.reshape(-1)[0]) if base.size
+                           else 0.0, np.float32)
+        base_pad[occupied] = base.reshape(dmat.num_row, self._K)[src]
+        entry = _CacheEntry(
+            dmat, jnp.asarray(binned_pad), jnp.asarray(base_pad),
+            row_valid=jnp.asarray(occupied), n_real=dmat.num_row)
+        entry.rank_pad_prep = prep
+        from xgboost_tpu.ops.histogram import _impl
+        if _impl(self.param.hist_precision).startswith("pallas"):
+            from xgboost_tpu.ops.pallas_hist import host_transpose_bins
+            bt = host_transpose_bins(binned_pad, self.gbtree.cfg.n_bin)
+            entry.binned_t = None if bt is None else jnp.asarray(bt)
+        return entry
+
     def _raw_dense(self, dmat):
         """Dense raw-value matrix for exact mode (NaN = missing),
         feature-padded/truncated to the model width.  Returns
@@ -606,9 +713,15 @@ class Booster:
                     # ranking objectives sample pairs host-side from the
                     # full margin; all-gather it in multi-process mode
                     margin = self._replicated(margin)
-                gh = self.obj.get_gradient(
-                    jnp.asarray(margin), entry.info,
-                    iteration, entry.margin.shape[0])
+                if entry.rank_pad_prep is not None:
+                    gh = self.obj.get_gradient(
+                        jnp.asarray(margin), entry.info, iteration,
+                        entry.margin.shape[0],
+                        pad_prep=entry.rank_pad_prep)
+                else:
+                    gh = self.obj.get_gradient(
+                        jnp.asarray(margin), entry.info,
+                        iteration, entry.margin.shape[0])
                 if prof:
                     p.block(gh)
         else:
@@ -621,7 +734,7 @@ class Booster:
             # zero-padded back to the device row count below in boost()
             pred = np.asarray(self._replicated(
                 self.obj.pred_transform(entry.margin)))
-            pred = pred[:entry.n_real]
+            pred = entry.user_rows(pred)
             if pred.shape[1] == 1:
                 pred = pred[:, 0]
             grad, hess = fobj(pred, dtrain)
@@ -648,6 +761,12 @@ class Booster:
         self._lazy_init(dtrain)
         entry = self._entry(dtrain)
         ups = parse_updaters(self.param.updater)
+
+        def fgrad():
+            if entry.rank_pad_prep is not None:
+                return self.obj.fused_grad(entry.info,
+                                           pad_prep=entry.rank_pad_prep)
+            return self.obj.fused_grad(entry.info)
         fused_ok = (
             fobj is None
             and n_rounds > 1
@@ -664,7 +783,7 @@ class Booster:
             and not getattr(self.gbtree, "exact_raw", False)
             and "refresh" not in ups
             and any(u.startswith("grow") for u in ups)
-            and self.obj.fused_grad(entry.info) is not None)
+            and fgrad() is not None)
         if not fused_ok:
             for i in range(first_iteration, first_iteration + n_rounds):
                 self.update(dtrain, i, fobj)
@@ -673,7 +792,7 @@ class Booster:
         self._sync_margin(entry)
         entry.margin = self.gbtree.do_boost_fused(
             entry.binned, entry.margin, entry.info,
-            self.obj.fused_grad(entry.info),
+            fgrad(),
             first_iteration, n_rounds, row_valid=entry.row_valid,
             mesh=self._mesh,
             binned_t=getattr(entry, "binned_t", None))
@@ -693,8 +812,15 @@ class Booster:
         h = np.asarray(hess, np.float32).reshape(dtrain.num_row, self._K)
         n_dev = (entry.binned.shape[0] if entry.binned is not None
                  else entry.margin.shape[0])  # external: no binned array
-        pad = n_dev - dtrain.num_row
-        if pad:  # zero-gradient padding rows (dsplit=row sharding)
+        if entry.rank_pad_prep is not None:
+            # group-padded layout: user rows scatter to their slots
+            gp = np.zeros((n_dev, self._K), np.float32)
+            hp = np.zeros((n_dev, self._K), np.float32)
+            gp[entry.rank_pad_prep.user_map] = g
+            hp[entry.rank_pad_prep.user_map] = h
+            g, h = gp, hp
+        elif n_dev - dtrain.num_row:  # zero-gradient padding rows
+            pad = n_dev - dtrain.num_row
             g = np.concatenate([g, np.zeros((pad, self._K), np.float32)])
             h = np.concatenate([h, np.zeros((pad, self._K), np.float32)])
         gh = jnp.stack([jnp.asarray(g), jnp.asarray(h)], axis=-1)
@@ -863,7 +989,7 @@ class Booster:
         if pred_leaf:
             leaves = np.asarray(self._replicated(
                 self.gbtree.predict_leaf(binned, ntree_limit, root=root)))
-            return leaves[:cached.n_real] if cached is not None else leaves
+            return cached.user_rows(leaves) if cached is not None else leaves
         if cached is not None and ntree_limit == 0:
             self._sync_margin(cached)
             margin = cached.margin
@@ -873,7 +999,7 @@ class Booster:
         out = self.obj.pred_transform(margin, output_margin=output_margin)
         out = np.asarray(self._replicated(out))
         if cached is not None:
-            out = out[:cached.n_real]
+            out = cached.user_rows(out)
         if out.ndim == 2 and out.shape[1] == 1:
             out = out[:, 0]
         return out
@@ -896,8 +1022,8 @@ class Booster:
             if getattr(dmat, "is_sharded", False):
                 self._eval_sharded(dmat, entry, name, parts, feval)
                 continue
-            tr = np.asarray(self._replicated(
-                self.obj.eval_transform(entry.margin)))[:entry.n_real]
+            tr = entry.user_rows(np.asarray(self._replicated(
+                self.obj.eval_transform(entry.margin))))
             labels = np.asarray(dmat.get_label())
             weights = np.asarray(dmat.get_weight())
             gptr = dmat.info.group_ptr

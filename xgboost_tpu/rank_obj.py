@@ -71,9 +71,28 @@ class LambdaRankObj(Objective):
             info._dev_cache[key] = build_prep(labels, gptr, n_pad)
         return info._dev_cache[key]
 
-    def _device_gradient(self, margin, info, iteration, n_rows):
+    def _pad_tag(self, pad_prep):
+        """The one cache-key construction for padded-gradient closures
+        (fused closure and its jitted per-round wrapper derive from it;
+        a second hand-built copy would drift)."""
+        return ("rank_fused_pad", self.kind, self.num_pairsample,
+                float(self.fix_list_weight), self.seed,
+                pad_prep.G, pad_prep.L, pad_prep.n_tail)
+
+    def _device_gradient(self, margin, info, iteration, n_rows,
+                         pad_prep=None):
         import jax
         import jax.numpy as jnp
+        if pad_prep is not None:
+            # the fused closure doubles as the per-round jit unit (the
+            # prep's shapes/maps are static only through a closure)
+            base = self.fused_grad(info, pad_prep=pad_prep)
+            tag = ("rank_pad_jit",) + self._pad_tag(pad_prep)
+            if tag not in info._dev_cache:
+                info._dev_cache[tag] = jax.jit(
+                    lambda m, it: base(m, None, None, it))
+            return info._dev_cache[tag](jnp.asarray(margin),
+                                        jnp.int32(iteration))
         from xgboost_tpu.rank_device import rank_gradient
         prep = self._prep(info, n_rows)
         key = jax.random.fold_in(
@@ -82,20 +101,39 @@ class LambdaRankObj(Objective):
                            self.num_pairsample, float(self.fix_list_weight))
         return gh[:, None, :]
 
-    def fused_grad(self, info=None):
+    def fused_grad(self, info=None, pad_prep=None):
         """Device rank gradients are pure in (margin, iteration) given
         the static per-dataset prep — fused-scan eligible.  The closure
         is cached ON THE INFO: its identity is a jit static argument of
         the fused scan, and a per-Booster closure would force a full
-        ~60 s re-trace for every new Booster on the same data."""
+        ~60 s re-trace for every new Booster on the same data.
+
+        ``pad_prep`` (a rank_device.PadRankPrep) selects the
+        group-padded gradient — passed by the learner for entries it
+        laid out padded (the entry and the prep share one layout)."""
         if self.rank_impl != "device" or info is None:
             return None
         import jax
-        from xgboost_tpu.rank_device import rank_gradient
         kind = self.kind
         nps = self.num_pairsample
         flw = float(self.fix_list_weight)
         seed = self.seed
+        if pad_prep is not None:
+            from xgboost_tpu.rank_device import rank_gradient_padded
+            key_tag = self._pad_tag(pad_prep)
+            if key_tag in info._dev_cache:
+                return info._dev_cache[key_tag]
+
+            def f(margin, label, weight, iteration):
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(4177 + seed), iteration)
+                gh = rank_gradient_padded(margin[:, 0], key, pad_prep,
+                                          kind, nps, flw)
+                return gh[:, None, :]
+
+            info._dev_cache[key_tag] = f
+            return f
+        from xgboost_tpu.rank_device import rank_gradient
         key_tag = ("rank_fused", kind, nps, flw, self.seed)
         if key_tag in info._dev_cache:
             return info._dev_cache[key_tag]
@@ -113,9 +151,11 @@ class LambdaRankObj(Objective):
         info._dev_cache[key_tag] = f
         return f
 
-    def get_gradient(self, margin, info, iteration, n_rows):
+    def get_gradient(self, margin, info, iteration, n_rows,
+                     pad_prep=None):
         if self.rank_impl == "device":
-            return self._device_gradient(margin, info, iteration, n_rows)
+            return self._device_gradient(margin, info, iteration, n_rows,
+                                         pad_prep)
         import jax.numpy as jnp
         preds = np.asarray(margin)[:, 0]
         labels = np.asarray(info.label)
