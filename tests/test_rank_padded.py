@@ -196,6 +196,51 @@ def test_padded_entry_invalidated_on_objective_switch():
     assert p.shape == (len(y),)
 
 
+def test_align_cut_lists():
+    """Bin-count alignment (binning.align_cut_lists): the densest
+    features trim to land B = max_cuts + 2 on the quantum; spread is
+    preserved (evenly rank-spaced selection); small/aligned inputs are
+    untouched."""
+    from xgboost_tpu.binning import align_cut_lists, pack_cuts
+    per = [np.arange(65, dtype=np.float32),
+           np.arange(10, dtype=np.float32)]
+    out = align_cut_lists(per, 32)
+    assert pack_cuts(out).max_bin == 64
+    assert len(out[0]) == 62 and len(out[1]) == 10
+    # trimmed cuts remain sorted and bracket the original range
+    assert out[0][0] == 0.0 and out[0][-1] == 64.0
+    assert (np.diff(out[0]) > 0).all()
+    # aligned input is a no-op
+    per2 = [np.arange(62, dtype=np.float32)]
+    assert align_cut_lists(per2, 32) is per2
+    # tiny inputs are never trimmed below 8 cuts
+    per3 = [np.arange(33, dtype=np.float32)]
+    out3 = align_cut_lists(per3, 32)
+    assert len(out3[0]) == 30  # B: 35 -> 32
+    per4 = [np.arange(20, dtype=np.float32)]
+    assert align_cut_lists(per4, 32) is per4  # target < 8 -> no-op
+    assert align_cut_lists(per, 0) is per
+
+
+def test_hist_bin_align_param_plumbing():
+    """hist_bin_align > 0 forces alignment regardless of backend (the
+    CPU scatter path ignores tiling but must honor the explicit knob);
+    0 disables; the model records the aligned cuts."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(40_000, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    b1 = xgb.Booster({"objective": "binary:logistic", "max_depth": 3,
+                      "hist_bin_align": 32}, cache=[d])
+    b1.update(d, 0)
+    assert b1.gbtree.cfg.n_bin % 32 == 0
+    d2 = xgb.DMatrix(X, label=y)
+    b2 = xgb.Booster({"objective": "binary:logistic", "max_depth": 3,
+                      "hist_bin_align": 0}, cache=[d2])
+    b2.update(d2, 0)
+    assert b2.gbtree.cfg.n_bin >= b1.gbtree.cfg.n_bin
+
+
 def test_padded_gate_declines_large_lanes():
     """A single huge group exceeds the lane cap -> sort path."""
     import os

@@ -1,0 +1,113 @@
+"""Capture + summarize a device trace of the fused binary round.
+
+Trains the bench workload (higgs-1M, depth 6) for a warmup + a traced
+30-round fused launch, then parses the xplane protobuf with
+tensorboard_plugin_profile and prints the top device ops by self time.
+This is the measurement tool behind the round-4/5 "where do the
+milliseconds go" tables in PROFILE.md.
+
+Usage: python tools/trace_round.py [workload]   (binary | multiclass | rank)
+"""
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+import xgboost_tpu as xgb  # noqa: E402
+from bench import make_higgs_like  # noqa: E402
+
+N_R = 30
+
+
+def build(workload):
+    if workload == "binary":
+        X, y = make_higgs_like(1_000_000)
+        d = xgb.DMatrix(X, label=y)
+        params = {"objective": "binary:logistic", "max_depth": 6,
+                  "eta": 0.1}
+    elif workload == "multiclass":
+        rng = np.random.RandomState(0)
+        X = rng.rand(200_000, 28).astype(np.float32)
+        y = (X[:, 0] * 6).astype(np.int32) % 6
+        d = xgb.DMatrix(X, label=y)
+        params = {"objective": "multi:softmax", "num_class": 6,
+                  "max_depth": 6, "eta": 0.1}
+    else:
+        rng = np.random.RandomState(0)
+        n, gs = 1_000_000, 100
+        X = rng.rand(n, 28).astype(np.float32)
+        y = (rng.rand(n) * 4).astype(np.int32).astype(np.float32)
+        d = xgb.DMatrix(X, label=y, group=[gs] * (n // gs))
+        params = {"objective": "rank:ndcg", "max_depth": 6, "eta": 0.1}
+    return d, params
+
+
+def barrier(b, d):
+    m = b._cache[id(d)].margin
+    jax.block_until_ready(m)
+    jax.device_get(np.asarray(m.ravel()[:1]))
+
+
+def main():
+    workload = sys.argv[1] if len(sys.argv) > 1 else "binary"
+    d, params = build(workload)
+    bst = xgb.Booster(params, cache=[d])
+    bst.update(d, 0)
+    bst.update_many(d, 1, N_R - 1)
+    barrier(bst, d)
+
+    trace_dir = tempfile.mkdtemp(prefix="xgtpu_trace_")
+    bst2 = xgb.Booster(params, cache=[d])
+    bst2.update(d, 0)
+    barrier(bst2, d)
+    jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    bst2.update_many(d, 1, N_R - 1)
+    barrier(bst2, d)
+    dt = time.perf_counter() - t0
+    jax.profiler.stop_trace()
+    print(f"{workload}: {(N_R - 1) / dt:.2f} rounds/s "
+          f"({dt / (N_R - 1) * 1e3:.2f} ms/round traced)")
+
+    xs = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                   recursive=True)
+    assert xs, f"no xplane under {trace_dir}"
+    from tensorboard_plugin_profile.convert import raw_to_tool_data
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        [xs[0]], "framework_op_stats^", {})
+    tbl = json.loads(data) if isinstance(data, (str, bytes)) else data
+    # framework_op_stats: list-of-dict rows or gviz table; normalize
+    rows = []
+    if isinstance(tbl, dict) and "data" in tbl:
+        cols = [c["label"] for c in tbl["cols"]]
+        for r in tbl["data"]:
+            rows.append(dict(zip(cols, [c["v"] for c in r["c"]])))
+    elif isinstance(tbl, list):
+        rows = tbl
+    out = []
+    for r in rows:
+        name = (r.get("Operation") or r.get("op_name")
+                or r.get("Type") or "?")
+        self_us = float(r.get("Total self-time (us)")
+                        or r.get("total_self_time_us") or 0.0)
+        dev = (r.get("Host/device") or r.get("host_or_device") or "")
+        if "evice" in str(dev) or dev == "":
+            out.append((self_us, name))
+    out.sort(reverse=True)
+    tot = sum(u for u, _ in out)
+    print(f"device self-time total: {tot / 1e3:.1f} ms "
+          f"({tot / 1e3 / (N_R - 1):.2f} ms/round)")
+    for us, name in out[:25]:
+        print(f"  {us / (N_R - 1):8.1f} us/round  {us / tot * 100:5.1f}%  "
+              f"{name[:100]}")
+    print("trace dir:", trace_dir)
+
+
+if __name__ == "__main__":
+    main()

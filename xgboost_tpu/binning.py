@@ -53,12 +53,15 @@ class CutMatrix:
 
 def compute_cuts(dmat: DMatrix, max_bin: int = 256, sketch_eps: float = 0.03,
                  sketch_ratio: float = 2.0,
-                 hess_weights: Optional[np.ndarray] = None) -> CutMatrix:
+                 hess_weights: Optional[np.ndarray] = None,
+                 bin_align: int = 0) -> CutMatrix:
     """Propose cut points for every feature via the weighted quantile sketch.
 
     Replaces the reference's per-round distributed sketch + cut proposal
     (``updater_histmaker-inl.hpp:353-462``) with one global pass; the
-    summary machinery (merge/prune bounds) is identical.
+    summary machinery (merge/prune bounds) is identical.  ``bin_align``
+    (learner-selected on TPU) aligns the bin count for the int8
+    histogram kernel — see :func:`align_cut_lists`.
     """
     F = dmat.num_col
     per_feature = []
@@ -73,7 +76,38 @@ def compute_cuts(dmat: DMatrix, max_bin: int = 256, sketch_eps: float = 0.03,
                 max(2, int(sketch_ratio / max(sketch_eps, 1.0 / max_bin))))
         cuts = propose_cuts(summary, max_bin - 1)  # leave room for missing bin
         per_feature.append(cuts)
-    return pack_cuts(per_feature)
+    return pack_cuts(align_cut_lists(per_feature, bin_align))
+
+
+def align_cut_lists(per_feature, quantum: int = 32):
+    """Trim the densest features' cut lists so the total bin count
+    ``max_cuts + 2`` lands on a multiple of ``quantum``.
+
+    The int8 MXU histogram kernel's one-hot operand tiles sublanes in
+    32s: B = 67 bins occupy 96 physical sublanes, B = 64 occupy 64 —
+    a measured ~19% round-rate difference at the bench shape for a
+    3-cut resolution change (tools/hist_r5_ab.py; higgs-1M AUC is
+    unchanged at the bench's precision).  Trimmed features keep evenly
+    rank-spaced cuts (quantile-uniform coverage).  No-op when quantum
+    is 0, when already aligned, or when the aligned count would drop
+    below 8 cuts.
+    """
+    if quantum <= 0 or not per_feature:
+        return per_feature
+    B = max((len(c) for c in per_feature), default=1) + 2
+    if B % quantum == 0:
+        return per_feature
+    target = (B // quantum) * quantum - 2    # cuts so B % quantum == 0
+    if target < 8:
+        return per_feature
+    out = []
+    for cuts in per_feature:
+        if len(cuts) > target:
+            idx = np.unique(np.round(
+                np.linspace(0, len(cuts) - 1, target)).astype(np.int64))
+            cuts = np.asarray(cuts)[idx]
+        out.append(cuts)
+    return out
 
 
 def _rank0() -> bool:

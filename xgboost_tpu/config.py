@@ -91,6 +91,13 @@ class TrainParam:
     # order of magnitude more than the kernel time it saves
     # (PROFILE.md round 3); 1 forces it on (numerics tested equal).
     hist_subtraction: int = -1
+    # bin-count alignment quantum for the int8 MXU histogram kernel:
+    # the one-hot operand tiles sublanes in 32s, so an unaligned bin
+    # count (e.g. 67) pads to the next multiple (96) and wastes up to
+    # a third of the kernel (~19% round rate at the bench shape).
+    # -1 auto = align to 32 when the pallas kernel is active; 0 = keep
+    # every proposed cut (exact sketch resolution)
+    hist_bin_align: int = -1
     # gblinear coordinate-descent block size: 1 = exact sequential CD
     # (convergent under feature correlation); >1 = shotgun-style parallel
     # updates within each block (reference gblinear-inl.hpp:76-105)

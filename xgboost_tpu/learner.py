@@ -204,7 +204,8 @@ class Booster:
                 else:
                     cuts = compute_cuts(dtrain, self.param.max_bin,
                                         self.param.sketch_eps,
-                                        self.param.sketch_ratio)
+                                        self.param.sketch_ratio,
+                                        bin_align=self._bin_align())
                 self.gbtree = GBTree(self.param, cuts)
                 if getattr(dtrain, "is_external", False):
                     # paged matrices route through the binned pipeline
@@ -504,6 +505,18 @@ class Booster:
         entry = _CacheEntry(dmat, binned, base, info=info,
                             row_valid=row_valid, n_real=dmat.global_num_row)
         return entry
+
+    def _bin_align(self) -> int:
+        """Bin-count alignment quantum for the cut proposal (see
+        binning.align_cut_lists): 32 when the pallas histogram kernel
+        will consume the bins (its int8 one-hot tiles sublanes in 32s),
+        else 0.  hist_bin_align overrides (0 = never, >0 = quantum)."""
+        hba = int(self.param.hist_bin_align)
+        if hba >= 0:
+            return hba
+        from xgboost_tpu.ops.histogram import _impl
+        return 32 if _impl(self.param.hist_precision
+                           ).startswith("pallas") else 0
 
     def _rank_pad_ok(self, dmat) -> bool:
         """Gate for the group-padded rank layout (rank_device round 4):
