@@ -413,8 +413,8 @@ def test_dp_collectives_in_compiled_program(mesh8):
     assert np.isfinite(np.asarray(deltas)).all()
 
     # and the distributed step matches single-device growth exactly
-    t1, _ = grow_tree(args[0], jnp.asarray(bin_dense(X, cuts)),
-                      jnp.asarray(gh), args[3], args[4], cfg)
+    t1, _, _ = grow_tree(args[0], jnp.asarray(bin_dense(X, cuts)),
+                         jnp.asarray(gh), args[3], args[4], cfg)
     for f in tree._fields:
         np.testing.assert_allclose(np.asarray(getattr(tree, f)),
                                    np.asarray(getattr(t1, f)),

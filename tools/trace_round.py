@@ -78,34 +78,24 @@ def main():
     xs = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                    recursive=True)
     assert xs, f"no xplane under {trace_dir}"
-    from tensorboard_plugin_profile.convert import raw_to_tool_data
-    data, _ = raw_to_tool_data.xspace_to_tool_data(
-        [xs[0]], "framework_op_stats^", {})
+    # NOTE use the xprof package, NOT tensorboard_plugin_profile (its
+    # generated protos predate the installed protobuf and crash)
+    from xprof.convert import raw_to_tool_data
+    data, _ = raw_to_tool_data.xspace_to_tool_data(xs, "hlo_stats", {})
     tbl = json.loads(data) if isinstance(data, (str, bytes)) else data
-    # framework_op_stats: list-of-dict rows or gviz table; normalize
-    rows = []
-    if isinstance(tbl, dict) and "data" in tbl:
-        cols = [c["label"] for c in tbl["cols"]]
-        for r in tbl["data"]:
-            rows.append(dict(zip(cols, [c["v"] for c in r["c"]])))
-    elif isinstance(tbl, list):
-        rows = tbl
-    out = []
-    for r in rows:
-        name = (r.get("Operation") or r.get("op_name")
-                or r.get("Type") or "?")
-        self_us = float(r.get("Total self-time (us)")
-                        or r.get("total_self_time_us") or 0.0)
-        dev = (r.get("Host/device") or r.get("host_or_device") or "")
-        if "evice" in str(dev) or dev == "":
-            out.append((self_us, name))
-    out.sort(reverse=True)
-    tot = sum(u for u, _ in out)
+    t = tbl[0] if isinstance(tbl, list) else tbl
+    cols = [c["id"] for c in t["cols"]]
+    rows = [dict(zip(cols, [c.get("v") for c in r["c"]]))
+            for r in t["rows"]]
+    rows.sort(key=lambda r: -float(r.get("total_self_time") or 0))
+    tot = sum(float(r.get("total_self_time") or 0) for r in rows)
     print(f"device self-time total: {tot / 1e3:.1f} ms "
           f"({tot / 1e3 / (N_R - 1):.2f} ms/round)")
-    for us, name in out[:25]:
+    for r in rows[:25]:
+        us = float(r.get("total_self_time") or 0)
         print(f"  {us / (N_R - 1):8.1f} us/round  {us / tot * 100:5.1f}%  "
-              f"{name[:100]}")
+              f"{str(r.get('category'))[:14]:14s} "
+              f"{str(r.get('hlo_op_expression'))[:110]}")
     print("trace dir:", trace_dir)
 
 

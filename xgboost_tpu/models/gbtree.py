@@ -60,21 +60,6 @@ def _unstack_trees(stacked, t: int):
     return tuple(jax.tree.map(lambda x: x[i], stacked) for i in range(t))
 
 
-@functools.partial(jax.jit, static_argnames=("K", "npar", "masked"))
-def _vmapped_deltas(stacked, row_leafs, row_valid, K: int, npar: int,
-                    masked: bool):
-    """Margin deltas of a vmapped growth launch: per-tree leaf-value
-    gathers + per-class accumulation, fused into one launch."""
-    N = row_leafs.shape[1]
-    deltas = jnp.zeros((N, K), jnp.float32)
-    for i in range(K * npar):
-        d = table_lookup(stacked.leaf_value[i], row_leafs[i])
-        if masked:
-            d = d * row_valid.astype(d.dtype)
-        deltas = deltas.at[:, i // npar].add(d)
-    return deltas
-
-
 @functools.partial(jax.jit, static_argnames=(
     "n_rounds", "K", "npar", "cfg", "split_finder", "grad_fn", "mesh"))
 def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
@@ -99,10 +84,9 @@ def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
                 mesh, tkey, binned, gh2, cut_values, n_cuts, cfg, rv,
                 split_finder=split_finder)
         else:
-            tree, row_leaf = grow_tree(
+            tree, row_leaf, d = grow_tree(
                 tkey, binned, gh2, cut_values, n_cuts, cfg, row_valid,
                 split_finder=split_finder, binned_t=binned_t)
-            d = table_lookup(tree.leaf_value, row_leaf)
         if row_valid is not None:
             d = d * row_valid.astype(d.dtype)
         return tree, d
@@ -292,19 +276,16 @@ class GBTree:
                         self.n_cuts_dev, self.cfg, rv,
                         split_finder=self._split_finder(), root=root)
                 else:
-                    tree, row_leaf = grow_tree(
+                    tree, row_leaf, d = grow_tree(
                         tkey, binned, gh[:, k, :], self.cut_values_dev,
                         self.n_cuts_dev, self.cfg, row_valid,
                         split_finder=self._split_finder(), root=root,
                         binned_t=binned_t)
-                    d = None
                 if do_prune:
                     tree, resolve = prune_tree(tree, self.param.gamma,
                                                self.cfg.n_roots)
                     d = table_lookup(tree.leaf_value[jnp.asarray(resolve)],
                                      row_leaf)
-                elif d is None:
-                    d = table_lookup(tree.leaf_value, row_leaf)
                 if row_valid is not None:
                     # padding rows land on node 0, which carries the root's
                     # would-be leaf weight; zero their delta so their cached
@@ -399,8 +380,7 @@ class GBTree:
                                  self.n_cuts_dev, self.cfg, row_valid,
                                  split_finder=self._split_finder(),
                                  root=root)
-            stacked, row_leafs = jax.vmap(one)(keys, gh_t)
-            ds = None
+            stacked, row_leafs, ds = jax.vmap(one)(keys, gh_t)
 
         new_trees = list(_unstack_trees(stacked, T))
         if do_prune:
@@ -416,18 +396,13 @@ class GBTree:
                     d = d * row_valid.astype(d.dtype)
                 new_trees[i] = tree
                 deltas = deltas.at[:, i // npar].add(d)
-        elif ds is not None:
+        else:
             deltas = jnp.zeros((binned.shape[0], K), jnp.float32)
             for i in range(T):
                 d = ds[i]
                 if row_valid is not None:
                     d = d * row_valid.astype(d.dtype)
                 deltas = deltas.at[:, i // npar].add(d)
-        else:
-            rv = (row_valid if row_valid is not None
-                  else jnp.ones((), jnp.bool_))
-            deltas = _vmapped_deltas(stacked, row_leafs, rv, K, npar,
-                                     row_valid is not None)
         for i, tree in enumerate(new_trees):
             self.trees.append(tree)
             self.tree_group.append(i // npar)

@@ -85,12 +85,11 @@ def _colsplit_fn(mesh: Mesh, cfg: GrowConfig, f_local: int, n_shard: int,
                                      n_shard=n_shard, f_real=f_real)
 
     def body(key, binned, gh, cut_values, n_cuts, row_valid):
-        tree, row_leaf = grow_tree(
+        tree, row_leaf, row_val = grow_tree(
             key, binned, gh, cut_values, n_cuts, cfg, row_valid,
             split_finder=split_finder, router=router,
             feat_sampler=feat_sampler)
-        delta = (table_lookup(tree.leaf_value, row_leaf)
-                 * row_valid.astype(jnp.float32))
+        delta = row_val * row_valid.astype(jnp.float32)
         return tree, row_leaf, delta
 
     # check_vma=False: every shard derives the SAME tree/row outputs from

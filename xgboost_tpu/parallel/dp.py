@@ -38,12 +38,13 @@ def grow_tree_dp(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
     Returns (tree [replicated], row_leaf (N,) [sharded]).
     """
     def body(key, binned, gh, cut_values, n_cuts, row_valid, root):
-        tree, row_leaf = grow_tree(key, binned, gh, cut_values, n_cuts, cfg,
-                                   row_valid, hist_reduce=_psum_data,
-                                   split_finder=split_finder,
-                                   root=root if cfg.n_roots > 1 else None)
-        # leaf-value gather stays inside the shard: indices are shard-local
-        return tree, row_leaf, table_lookup(tree.leaf_value, row_leaf)
+        tree, row_leaf, row_val = grow_tree(
+            key, binned, gh, cut_values, n_cuts, cfg,
+            row_valid, hist_reduce=_psum_data,
+            split_finder=split_finder,
+            root=root if cfg.n_roots > 1 else None)
+        # the leaf value was recorded at parking time, inside the shard
+        return tree, row_leaf, row_val
 
     if root is None:
         root = jnp.zeros(binned.shape[0], jnp.int32)
