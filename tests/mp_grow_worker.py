@@ -29,7 +29,8 @@ def main():
     out_path = sys.argv[1]
     rank = jax.process_index()
     nproc = jax.process_count()
-    assert jax.device_count() == 4
+    # 2 local devices per process; the GLOBAL mesh spans all processes
+    assert jax.device_count() == 2 * nproc
 
     from xgboost_tpu.binning import bin_dense, compute_cuts
     from xgboost_tpu.data import DMatrix
