@@ -162,3 +162,89 @@ def test_vmap_batched_binned_falls_back_to_map(monkeypatch):
         want = np.asarray(build_level_histogram(binned[t], gh[t], pos[t],
                                                 M, B))
         np.testing.assert_array_equal(got[t], want)
+
+
+def test_native_split_finder_matches_standard():
+    """find_best_splits_native on the kernel-native (F, B, 2, M) layout
+    must equal find_best_splits on (M, F, B, 2) EXACTLY (same candidate
+    order, tie-breaks, gains and winner sums)."""
+    from xgboost_tpu.ops.split import (SplitConfig, find_best_splits,
+                                       find_best_splits_native)
+    rng = np.random.RandomState(0)
+    M, F, B = 16, 7, 12
+    hist = jnp.asarray(rng.rand(M, F, B, 2).astype(np.float32))
+    hist = hist.at[..., 1].set(hist[..., 1] * 3)
+    nst = hist[:, 0, :, :].sum(axis=1)
+    n_cuts = jnp.asarray(rng.randint(3, B - 2, F).astype(np.int32))
+    fmask = jnp.asarray(rng.rand(F) > 0.2)
+    for cfg in (SplitConfig(min_child_weight=0.5),
+                SplitConfig(reg_alpha=0.1, default_direction=1),
+                SplitConfig(max_delta_step=0.7)):
+        a = find_best_splits(hist, nst, n_cuts, cfg, fmask)
+        b = find_best_splits_native(hist.transpose(1, 2, 3, 0), nst,
+                                    n_cuts, cfg, fmask)
+        for f in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                err_msg=f)
+
+
+def test_native_grow_matches_scatter(monkeypatch):
+    """grow_tree through the native-layout prep path (pallas fp32,
+    interpret on CPU) grows the EXACT tree the scatter path grows."""
+    import jax.random
+    from xgboost_tpu.binning import bin_dense, compute_cuts
+    from xgboost_tpu.config import TrainParam
+    from xgboost_tpu.data import DMatrix
+    from xgboost_tpu.models.gbtree import make_grow_config
+    from xgboost_tpu.models.tree import grow_tree
+    from xgboost_tpu.ops.pallas_hist import host_transpose_bins
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(2048, 5).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0.8).astype(np.float32)
+    cuts = compute_cuts(DMatrix(X, label=y), max_bin=16)
+    cfg = make_grow_config(TrainParam(max_depth=4, eta=0.5), cuts.max_bin)
+    binned = bin_dense(X, cuts)
+    bt = host_transpose_bins(binned, cuts.max_bin)
+    gh = np.stack([0.5 - y, np.full_like(y, 0.25)], axis=1)
+    args = (jax.random.PRNGKey(7), jnp.asarray(binned), jnp.asarray(gh),
+            jnp.asarray(cuts.cut_values), jnp.asarray(cuts.n_cuts), cfg)
+
+    monkeypatch.setenv("XGBTPU_HIST", "pallas")
+    t_n, rl_n, rv_n = jax.jit(
+        lambda *a: grow_tree.__wrapped__(*a, binned_t=jnp.asarray(bt)),
+        static_argnums=(5,))(*args)
+    monkeypatch.setenv("XGBTPU_HIST", "scatter")
+    t_s, rl_s, rv_s = jax.jit(
+        lambda *a: grow_tree.__wrapped__(*a), static_argnums=(5,))(*args)
+    for f in t_n._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_n, f)), np.asarray(getattr(t_s, f)),
+            err_msg=f)
+    np.testing.assert_array_equal(np.asarray(rl_n), np.asarray(rl_s))
+    np.testing.assert_array_equal(np.asarray(rv_n), np.asarray(rv_s))
+
+
+def test_native_vmapped_multiclass_matches_scatter(monkeypatch):
+    """The ensemble (vmapped) native path — batched kernel emitting
+    (T, F, B, 2, M) in one relayout — must train the same multiclass
+    model as the scatter path (fp32 pallas, interpret on CPU)."""
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(5)
+    X = rng.rand(3000, 5).astype(np.float32)
+    yc = (X[:, 0] * 3).astype(np.int32) % 3
+    params = {"objective": "multi:softmax", "num_class": 3,
+              "max_depth": 3, "eta": 0.5, "max_bin": 16}
+
+    preds = {}
+    for impl in ("pallas", "scatter"):
+        monkeypatch.setenv("XGBTPU_HIST", impl)
+        d = xgb.DMatrix(X, label=yc)
+        bst = xgb.Booster(params, cache=[d])
+        bst.update(d, 0)
+        bst.update(d, 1)
+        preds[impl] = np.asarray(bst.predict(d, output_margin=True))
+    np.testing.assert_allclose(preds["pallas"], preds["scatter"],
+                               rtol=1e-5, atol=1e-6)

@@ -130,12 +130,18 @@ def prepare_hist(binned, gh, n_bin: int, precision: str = "auto",
 
 @functools.lru_cache(maxsize=None)
 def _pallas_hist_pre_vmappable(n_node: int, n_bin: int, precision: str,
-                               interpret: bool, has_scale: bool):
+                               interpret: bool, has_scale: bool,
+                               native: bool = False):
     """custom_vmap wrapper over PREPARED operands: the unbatched call
     runs the kernel on the hoisted transpose/quantization; a vmapped
     ensemble axis dispatches to the tree-batched kernel from the raw
     bins (its tiling depends on the tree count, so it re-transposes —
-    cheap at ensemble workloads' row counts)."""
+    cheap at ensemble workloads' row counts).
+
+    ``native`` returns the kernel's (F, B, 2, n_node) layout (see
+    pallas_hist._hist_pallas_pre); the batched rule asks the batched
+    kernel for the native order directly (its single relayout pass
+    emits either order — no extra transpose either way)."""
     from jax.custom_batching import custom_vmap
     from xgboost_tpu.ops import pallas_hist as ph
 
@@ -147,7 +153,8 @@ def _pallas_hist_pre_vmappable(n_node: int, n_bin: int, precision: str,
         def hist(binned, binned_t, gh_in, scale, pos):
             return ph._hist_pallas_pre(binned_t, gh_in, scale, pos,
                                        _nf(binned), n_node, n_bin,
-                                       precision, interpret)
+                                       precision, interpret,
+                                       native=native)
 
         @hist.def_vmap
         def _rule(axis_size, in_batched, binned, binned_t, gh_in,
@@ -165,14 +172,15 @@ def _pallas_hist_pre_vmappable(n_node: int, n_bin: int, precision: str,
             out = ph._hist_pallas_batched_prequant(
                 binned, bc(gh_in, in_batched[2]),
                 bc(scale, in_batched[3]), bc(pos, in_batched[4]),
-                n_node, n_bin, precision, interpret)
+                n_node, n_bin, precision, interpret, native=native)
             return out, True
     else:
         @custom_vmap
         def hist(binned, binned_t, gh_in, pos):
             return ph._hist_pallas_pre(binned_t, gh_in, None, pos,
                                        _nf(binned), n_node, n_bin,
-                                       precision, interpret)
+                                       precision, interpret,
+                                       native=native)
 
         @hist.def_vmap
         def _rule(axis_size, in_batched, binned, binned_t, gh_in, pos):
@@ -188,7 +196,7 @@ def _pallas_hist_pre_vmappable(n_node: int, n_bin: int, precision: str,
             out = ph._hist_pallas_batched_prequant(
                 binned, bc(gh_in, in_batched[2]), None,
                 bc(pos, in_batched[3]), n_node, n_bin, precision,
-                interpret)
+                interpret, native=native)
             return out, True
 
     return hist
@@ -197,7 +205,7 @@ def _pallas_hist_pre_vmappable(n_node: int, n_bin: int, precision: str,
 def build_level_histogram(binned: jax.Array, gh: jax.Array, pos: jax.Array,
                           n_node: int, n_bin: int,
                           precision: str = "auto",
-                          prep=None) -> jax.Array:
+                          prep=None, native: bool = False) -> jax.Array:
     """Accumulate per-(node, feature, bin) grad/hess sums for one level.
 
     Args:
@@ -211,17 +219,19 @@ def build_level_histogram(binned: jax.Array, gh: jax.Array, pos: jax.Array,
               the level loop hoists the bins transpose and gradient
               quantization to once per tree instead of once per level.
 
-    Returns: (n_node, F, B, 2) float32.
+    Returns: (n_node, F, B, 2) float32 — or the kernel-native
+    (F, B, 2, n_node) when ``native`` (prep path only, n_node <= 64).
     """
     if prep is not None:
         fn = _pallas_hist_pre_vmappable(
             n_node, n_bin, prep.precision,
             jax.default_backend() != "tpu",
-            prep.scale is not None)
+            prep.scale is not None, native)
         if prep.scale is not None:
             return fn(prep.binned, prep.binned_t, prep.gh_in,
                       prep.scale, pos)
         return fn(prep.binned, prep.binned_t, prep.gh_in, pos)
+    assert not native, "native layout requires the pallas prep path"
     impl = _impl(precision)
     if impl.startswith("pallas"):
         precision = {"pallas_bf16": "bf16", "pallas_int8": "int8",
@@ -249,6 +259,12 @@ def node_stats(gh: jax.Array, pos: jax.Array, n_node: int) -> jax.Array:
     idx = jnp.where(pos < 0, n_node, pos)
     out = jnp.zeros((n_node, 2), dtype=jnp.float32)
     return out.at[idx].add(gh, mode="drop")
+
+
+def stats_from_histogram_native(hist: jax.Array) -> jax.Array:
+    """Per-node (G, H) totals from the NATIVE (F, B, 2, n_node) layout:
+    bin sums of feature 0 (same identity as stats_from_histogram)."""
+    return hist[0].sum(axis=0).T
 
 
 def stats_from_histogram(hist: jax.Array) -> jax.Array:

@@ -215,10 +215,15 @@ def build_level_histogram_pallas(binned: jax.Array, gh: jax.Array,
 
 
 def _hist_pallas_pre(binned_t, gh_in, scale, pos, nf, n_node: int,
-                     n_bin: int, precision: str, interpret: bool
-                     ) -> jax.Array:
+                     n_bin: int, precision: str, interpret: bool,
+                     native: bool = False) -> jax.Array:
     """Kernel invocation on PREPARED operands (transpose_bins /
-    quantize_gh hoisted to once per tree/round by the grow loop)."""
+    quantize_gh hoisted to once per tree/round by the grow loop).
+
+    ``native=True`` returns the kernel's own ``(F, B, 2, n_node)``
+    layout (node minor) without the relayout transpose — consumed by
+    split.find_best_splits_native; callers gate on n_node <= 64
+    (single node tile)."""
     N, F = nf
     r_tile, f_tile, n_pad, f_pad = _tiling(N, F, n_bin)
     # deep levels tile the node dim at 64 (lane dim 2*64 = one full MXU
@@ -248,6 +253,13 @@ def _hist_pallas_pre(binned_t, gh_in, scale, pos, nf, n_node: int,
         interpret=interpret,
     )(binned_t, pos_t, gh_t)
 
+    if native:
+        assert n_m_tiles == 1, "native layout needs a single node tile"
+        out = out.reshape(f_pad, n_bin, 2, m_pad)[:F, :, :, :n_node]
+        if precision == "int8":
+            out = (out.astype(jnp.float32)
+                   * (scale / 127.0)[None, None, :, None])
+        return out
     # (m_tiles, f_pad*B, 2M) -> (m_tiles, F, B, 2, M) -> (m_tiles*M, F, B, 2)
     out = out.reshape(n_m_tiles, f_pad, n_bin, 2, m_pad)
     out = out.transpose(0, 4, 1, 2, 3).reshape(
@@ -364,16 +376,20 @@ def build_level_histogram_pallas_batched(binned: jax.Array, gh: jax.Array,
 
 def _hist_pallas_batched_prequant(binned, gh_in, scale, pos, n_node: int,
                                   n_bin: int, precision: str,
-                                  interpret: bool) -> jax.Array:
+                                  interpret: bool,
+                                  native: bool = False) -> jax.Array:
     """Batched kernel from RAW bins + pre-quantized gradients (the
     ensemble vmap rule of the prep path: batched tiling depends on the
-    tree count, so the transpose happens here per call)."""
+    tree count, so the transpose happens here per call).  ``native``
+    emits (T, F, B, 2, n_node) in the same single relayout pass the
+    standard order takes."""
     T, N, _ = gh_in.shape
     F = binned.shape[1]
     return _hist_pallas_batched_pre(
         transpose_bins_batched(binned, n_bin, T, min(n_node, 64),
                                precision), gh_in,
-        scale, pos, (N, F), n_node, n_bin, precision, interpret)
+        scale, pos, (N, F), n_node, n_bin, precision, interpret,
+        native=native)
 
 
 def transpose_bins_batched(binned, n_bin: int, T: int, m_pad: int,
@@ -426,7 +442,8 @@ def _tiling_batched(N, F, n_bin, T, m_pad, precision):
 
 def _hist_pallas_batched_pre(binned_t, gh, scale, pos, nf, n_node: int,
                              n_bin: int, precision: str,
-                             interpret: bool) -> jax.Array:
+                             interpret: bool,
+                             native: bool = False) -> jax.Array:
     N, F = nf
     T = gh.shape[0]
     m_pad = min(n_node, 64)
@@ -469,6 +486,17 @@ def _hist_pallas_batched_pre(binned_t, gh, scale, pos, nf, n_node: int,
 
     # (m_tiles, t_tiles, f_pad*B, t_tile*2M) -> (T, m_tiles*M, F, B, 2)
     out = out.reshape(n_m_tiles, t_tiles, f_pad, n_bin, t_tile, 2, m_pad)
+    if native:
+        # ONE relayout straight to (T, F, B, 2, m_tiles*M) — composing
+        # the standard transpose with a to-native pass would copy the
+        # whole histogram twice per level
+        out = out.transpose(1, 4, 2, 3, 5, 0, 6).reshape(
+            T_pad, f_pad, n_bin, 2, n_m_tiles * m_pad)
+        out = out[:T, :F, :, :, :n_node]
+        if precision == "int8":
+            out = (out.astype(jnp.float32)
+                   * (scale / 127.0)[:, None, None, :, None])
+        return out
     out = out.transpose(1, 4, 0, 6, 2, 3, 5).reshape(
         T_pad, n_m_tiles * m_pad, f_pad, n_bin, 2)
     out = out[:T, :n_node, :F, :, :]
