@@ -195,3 +195,28 @@ def test_set_uint_info_rejects_bad_values():
         d.set_uint_info("root_index", np.array([-1, 0, 0, 0]))
     with pytest.raises(ValueError):
         d.set_uint_info("fold_index", np.array([0.5, 1, 2, 3]))
+
+
+def test_bin_dense_device_matches_host():
+    """Device-side quantization (binning.bin_dense_device, the
+    prediction-time fast path) must agree bin-for-bin with the host
+    searchsorted, including NaN -> missing bin 0."""
+    import numpy as np
+    import xgboost_tpu as xgb
+    from xgboost_tpu.binning import (bin_dense_device, bin_matrix,
+                                     compute_cuts)
+    rng = np.random.RandomState(0)
+    X = rng.rand(5000, 7).astype(np.float32)
+    X[rng.rand(5000, 7) < 0.3] = np.nan
+    d = xgb.DMatrix(X)
+    cuts = compute_cuts(d, max_bin=16)
+    host = bin_matrix(d, cuts)
+    dev = np.asarray(bin_dense_device(X, cuts.cut_values))
+    np.testing.assert_array_equal(host, dev)
+    # boundary values land in the same bin as the host side=right rule
+    Xb = np.asarray(cuts.cut_values[:1, :3]).T.astype(np.float32)
+    Xb = np.broadcast_to(Xb, (3, 7)).copy()
+    db = xgb.DMatrix(Xb)
+    np.testing.assert_array_equal(
+        bin_matrix(db, cuts), np.asarray(bin_dense_device(
+            Xb, cuts.cut_values)))

@@ -191,6 +191,23 @@ def bin_matrix(dmat: DMatrix, cuts: CutMatrix) -> np.ndarray:
     return out
 
 
+def bin_dense_device(X, cut_values):
+    """Device-side quantization of a dense (N, F) float matrix (NaN =
+    missing -> bin 0): ``1 + #{c: x >= cut[c]}`` — identical to the
+    host ``searchsorted(side="right")`` since cut lists are sorted and
+    inf-padded.  One fused (N, F, C) compare-reduce: ~2 ms at 1M x 28
+    on v5e where the host loop takes seconds (prediction-time path;
+    PROFILE.md round 4)."""
+    import jax
+    import jax.numpy as jnp
+    X = jnp.asarray(X, jnp.float32)
+    cv = jnp.asarray(cut_values, jnp.float32)
+    b = 1 + jnp.sum(X[:, :, None] >= cv[None, :, :],
+                    axis=2).astype(jnp.int32)
+    b = jnp.where(jnp.isnan(X), 0, b)
+    return b.astype(jnp.uint8 if cv.shape[1] + 2 <= 256 else jnp.uint16)
+
+
 def bin_dense(X: np.ndarray, cuts: CutMatrix, missing: float = np.nan) -> np.ndarray:
     """Quantize a dense float matrix directly (prediction-time fast path)."""
     n, F = X.shape

@@ -531,26 +531,64 @@ def _traverse_one(tree: TreeArrays, binned: jax.Array, max_depth: int,
     including missing-value default direction; with ``root`` (the
     per-row root_index, data.h:39-58) traversal starts at that root
     slot instead of node 0.
+
+    Level-LOCAL like the grower: at depth d a row can only sit in one
+    of 2^d nodes, so the per-node lookups compare against a STATIC
+    SLICE of the tree arrays (2^d wide) instead of the full perfect
+    layout — sliced lookups total ~5 * n_nodes compare-selects per
+    tree where full-table lookups cost ~5 * n_nodes * depth (measured
+    6.3 s -> see PROFILE.md for 1M rows x 100 depth-6 trees).  All
+    five channels share one (N, 2^d) compare, as in growth.
     """
-    # derive from binned so the row sharding (dsplit=row) carries over
-    node = jnp.zeros_like(binned[:, 0], dtype=jnp.int32)
-    if n_roots > 1:
-        # ALWAYS offset into the root-slot level: nodes above it are
-        # synthetic placeholders; root=None means "everyone at root 0"
-        # (consistent with growth, where pos=0 is slot 0 of that level)
-        d0 = root_level(n_roots)
-        node = node + (1 << d0) - 1
-        if root is not None:
-            node = node + jnp.clip(root.astype(jnp.int32), 0, n_roots - 1)
-    for _ in range(max_depth):
-        f = table_lookup(tree.feature, node)
-        leaf = table_lookup(tree.is_leaf, node) | (f < 0)
-        b = bin_of_feature(binned, jnp.maximum(f, 0))
-        go_left = jnp.where(b == 0, table_lookup(tree.default_left, node),
-                            b <= table_lookup(tree.cut_index, node) + 1)
-        nxt = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
-        node = jnp.where(leaf, node, nxt)
-    return node
+    N = binned.shape[0]
+    d0 = root_level(n_roots)
+    # level-local position within depth level d0 + d; parked rows keep
+    # their GLOBAL leaf index in `leaf_node` and pos = -1
+    if n_roots > 1 and root is not None:
+        pos = jnp.clip(root.astype(jnp.int32), 0, n_roots - 1)
+    else:
+        pos = jnp.zeros_like(binned[:, 0], dtype=jnp.int32)
+    leaf_node = jnp.zeros(N, jnp.int32)
+    for d in range(d0, d0 + max_depth + 1):
+        n_node = 1 << d
+        base = n_node - 1
+        sl = slice(base, base + n_node)
+        active = pos >= 0
+        node = jnp.clip(pos, 0, n_node - 1)
+        if n_node <= 1024:
+            ids = jnp.arange(n_node, dtype=jnp.int32)
+            sel = node[:, None] == ids
+
+            def pick(v):
+                return jnp.where(sel, v[None, :], 0.0).sum(axis=1)
+            f_row = pick(tree.feature[sl].astype(jnp.float32)
+                         ).astype(jnp.int32)
+            is_leaf_row = pick(tree.is_leaf[sl].astype(jnp.float32)) \
+                != 0.0
+        else:
+            # very deep levels: compare-select stops paying (see
+            # table_lookup) — per-level gathers on the slices
+            def pick(v):
+                return table_lookup(v, node)
+            f_row = pick(tree.feature[sl])
+            is_leaf_row = pick(tree.is_leaf[sl])
+        stop = active & (is_leaf_row | (f_row < 0) | (d == d0 + max_depth))
+        leaf_node = jnp.where(stop, base + pos, leaf_node)
+        if d == d0 + max_depth:
+            break
+        if n_node <= 1024:
+            j1_row = pick(tree.cut_index[sl].astype(jnp.float32) + 1.0)
+            dl_row = pick(tree.default_left[sl].astype(jnp.float32)) \
+                != 0.0
+        else:
+            j1_row = pick(tree.cut_index[sl]).astype(jnp.float32) + 1.0
+            dl_row = pick(tree.default_left[sl])
+        b = bin_of_feature(binned, jnp.maximum(f_row, 0))
+        go_left = jnp.where(b == 0, dl_row,
+                            b.astype(jnp.float32) <= j1_row)
+        new_pos = 2 * pos + (~go_left).astype(jnp.int32)
+        pos = jnp.where(active & ~stop, new_pos, -1)
+    return leaf_node
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_group",
