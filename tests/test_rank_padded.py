@@ -220,6 +220,17 @@ def test_align_cut_lists():
     per4 = [np.arange(20, dtype=np.float32)]
     assert align_cut_lists(per4, 32) is per4  # target < 8 -> no-op
     assert align_cut_lists(per, 0) is per
+    # advisor (round 4): B far above the lower multiple must NOT collapse
+    # down to it — B=63 keeps all 61 cuts (1 padded sublane beats losing
+    # half the resolution)...
+    per5 = [np.arange(61, dtype=np.float32)]
+    assert align_cut_lists(per5, 32) is per5
+    # ...unless the caller explicitly lifts the cap (hist_bin_align>0)
+    out5 = align_cut_lists(per5, 32, trim_margin=None)
+    assert pack_cuts(out5).max_bin == 32 and len(out5[0]) == 30
+    # boundary: excess == margin still trims
+    per6 = [np.arange(66, dtype=np.float32)]   # B = 68, excess 4
+    assert pack_cuts(align_cut_lists(per6, 32)).max_bin == 64
 
 
 def test_hist_bin_align_param_plumbing():
@@ -253,3 +264,43 @@ def test_padded_gate_declines_large_lanes():
     bst.update(d, 0)
     assert bst._cache[id(d)].rank_pad_prep is None
     assert os.environ.get("XGBTPU_RANK_PAD") is None
+
+
+def test_rank_path_announcement():
+    """The first boosting round prints exactly one `[rank] LambdaRank
+    gradient path:` stderr line naming the choice for the TRAINING
+    matrix (README 'Ranking'); XGBTPU_RANK_PAD=0 flips it to
+    sort-based; silent=1 mutes (advisor, round 4)."""
+    import contextlib
+    import io
+    import os
+    import numpy as np
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(0)
+    n, G = 1000, 50
+    X = rng.rand(n, 6).astype(np.float32)
+    y = rng.randint(0, 4, n).astype(np.float32)
+
+    def run(silent=0):
+        d = xgb.DMatrix(X, label=y)
+        d.set_group(np.full(G, n // G, np.int64))
+        dv = xgb.DMatrix(X[:200], label=y[:200])
+        dv.set_group(np.full(10, 20, np.int64))
+        err = io.StringIO()
+        with contextlib.redirect_stderr(err):
+            xgb.train({"objective": "rank:ndcg", "max_depth": 3,
+                       "eta": 0.3, "silent": silent}, d, 2,
+                      evals=[(dv, "val")], verbose_eval=False)
+        return [l for l in err.getvalue().splitlines()
+                if l.startswith("[rank] LambdaRank gradient path")]
+
+    lines = run()
+    assert len(lines) == 1 and "group-padded" in lines[0], lines
+    os.environ["XGBTPU_RANK_PAD"] = "0"
+    try:
+        lines = run()
+    finally:
+        del os.environ["XGBTPU_RANK_PAD"]
+    assert len(lines) == 1 and "sort-based" in lines[0], lines
+    assert run(silent=1) == []

@@ -29,6 +29,12 @@ from xgboost_tpu.data import DMatrix
 from xgboost_tpu.sketch import (QuantileSummary, make_summary, prune_summary,
                                 propose_cuts, sketch_column)
 
+# Auto bin alignment trims at most this many cuts (the measured win is
+# landing on the sublane multiple just BELOW the proposed count; see
+# align_cut_lists).  Single source of truth — the learner and
+# compute_cuts both defer to this default.
+DEFAULT_TRIM_MARGIN = 4
+
 
 @dataclasses.dataclass
 class CutMatrix:
@@ -54,7 +60,9 @@ class CutMatrix:
 def compute_cuts(dmat: DMatrix, max_bin: int = 256, sketch_eps: float = 0.03,
                  sketch_ratio: float = 2.0,
                  hess_weights: Optional[np.ndarray] = None,
-                 bin_align: int = 0) -> CutMatrix:
+                 bin_align: int = 0,
+                 bin_align_margin: Optional[int] = DEFAULT_TRIM_MARGIN
+                 ) -> CutMatrix:
     """Propose cut points for every feature via the weighted quantile sketch.
 
     Replaces the reference's per-round distributed sketch + cut proposal
@@ -76,10 +84,12 @@ def compute_cuts(dmat: DMatrix, max_bin: int = 256, sketch_eps: float = 0.03,
                 max(2, int(sketch_ratio / max(sketch_eps, 1.0 / max_bin))))
         cuts = propose_cuts(summary, max_bin - 1)  # leave room for missing bin
         per_feature.append(cuts)
-    return pack_cuts(align_cut_lists(per_feature, bin_align))
+    return pack_cuts(align_cut_lists(per_feature, bin_align,
+                                     bin_align_margin))
 
 
-def align_cut_lists(per_feature, quantum: int = 32):
+def align_cut_lists(per_feature, quantum: int = 32,
+                    trim_margin: Optional[int] = DEFAULT_TRIM_MARGIN):
     """Trim the densest features' cut lists so the total bin count
     ``max_cuts + 2`` lands on a multiple of ``quantum``.
 
@@ -91,11 +101,25 @@ def align_cut_lists(per_feature, quantum: int = 32):
     rank-spaced cuts (quantile-uniform coverage).  No-op when quantum
     is 0, when already aligned, or when the aligned count would drop
     below 8 cuts.
+
+    ``trim_margin`` caps how many cuts may be trimmed: the win only
+    exists when B sits just ABOVE a sublane multiple (67 -> 64 frees a
+    whole 32-sublane tier for 3 cuts of resolution).  B = 63 is 31
+    above the lower multiple — trimming to 32 would halve histogram
+    resolution to save a single padded sublane, so alignment is
+    skipped and the kernel pads instead (advisor finding, round 4).
+    ``trim_margin=None`` removes the cap (explicit hist_bin_align>0
+    opts into unconditional alignment).
     """
     if quantum <= 0 or not per_feature:
         return per_feature
     B = max((len(c) for c in per_feature), default=1) + 2
-    if B % quantum == 0:
+    excess = B % quantum
+    if excess == 0:
+        return per_feature
+    if trim_margin is not None and excess > trim_margin:
+        # Keeping all cuts costs quantum - excess padded sublanes in the
+        # kernel — cheaper than losing `excess` cuts of resolution.
         return per_feature
     target = (B // quantum) * quantum - 2    # cuts so B % quantum == 0
     if target < 8:
@@ -179,7 +203,11 @@ def bin_matrix(dmat: DMatrix, cuts: CutMatrix) -> np.ndarray:
     out = np.zeros((n, F), dtype=dtype)
     rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(dmat.indptr))
     cols = dmat.indices
-    in_range = cols < F
+    # explicitly-stored NaNs are missing (bin 0) — same as an absent CSR
+    # entry and as bin_dense_device's isnan mask (searchsorted would
+    # otherwise send NaN to the LAST bin, routing the same data
+    # differently depending on which quantizer ran; advisor, round 4)
+    in_range = (cols < F) & ~np.isnan(dmat.values)
     rows, cols, vals = rows[in_range], cols[in_range], dmat.values[in_range]
     for f in range(F):
         m = cols == f
@@ -202,7 +230,12 @@ def bin_dense_device(X, cut_values):
     import jax.numpy as jnp
     X = jnp.asarray(X, jnp.float32)
     cv = jnp.asarray(cut_values, jnp.float32)
-    b = 1 + jnp.sum(X[:, :, None] >= cv[None, :, :],
+    # the +inf PADDING columns must not count: x=+inf satisfies
+    # inf >= inf, which would yield bin 1 + max_cuts instead of the
+    # host searchsorted's 1 + n_cuts[f] (real cuts are finite — they
+    # come from sketch summaries, which filter non-finite values)
+    b = 1 + jnp.sum((X[:, :, None] >= cv[None, :, :])
+                    & jnp.isfinite(cv)[None, :, :],
                     axis=2).astype(jnp.int32)
     b = jnp.where(jnp.isnan(X), 0, b)
     return b.astype(jnp.uint8 if cv.shape[1] + 2 <= 256 else jnp.uint16)

@@ -85,12 +85,13 @@ class TrainParam:
     # "fp32" forces exact-f32 histograms; "bf16" forces the MXU pass.
     # XGBTPU_HIST remains an env override (test seam).
     hist_precision: str = "auto"
-    # histogram subtraction + row compaction: build only the smaller
-    # child per parent, derive the sibling as parent - small.  -1 auto
-    # resolves to OFF — measured on v5e, XLA row compaction costs an
-    # order of magnitude more than the kernel time it saves
-    # (PROFILE.md round 3); 1 forces it on (numerics tested equal).
-    hist_subtraction: int = -1
+    # histogram subtraction + row compaction (build only the smaller
+    # child per parent, derive the sibling as parent - small) is NOT a
+    # config param: measured on v5e, XLA row compaction costs an order
+    # of magnitude more than the kernel time it saves (PROFILE.md
+    # round 3), so the public surface carries no known-10x-slower knob
+    # (advisor, round 4).  The A/B stays reachable for kernel work via
+    # env XGBTPU_HIST_SUBTRACTION=1 (numerics tested equal).
     # bin-count alignment quantum for the int8 MXU histogram kernel:
     # the one-hot operand tiles sublanes in 32s, so an unaligned bin
     # count (e.g. 67) pads to the next multiple (96) and wastes up to

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from xgboost_tpu.binning import _rank0 as _is_rank0
 from xgboost_tpu.binning import bin_matrix, compute_cuts
 from xgboost_tpu.config import TrainParam
 from xgboost_tpu.data import DMatrix, MetaInfo
@@ -202,10 +203,17 @@ class Booster:
                         self.param.max_bin, self.param.sketch_eps,
                         self.param.sketch_ratio)
                 else:
+                    # explicit hist_bin_align>0 lifts the trim-margin
+                    # cap (unconditional alignment); auto keeps
+                    # binning.DEFAULT_TRIM_MARGIN
+                    margin_kw = ({"bin_align_margin": None}
+                                 if int(self.param.hist_bin_align) > 0
+                                 else {})
                     cuts = compute_cuts(dtrain, self.param.max_bin,
                                         self.param.sketch_eps,
                                         self.param.sketch_ratio,
-                                        bin_align=self._bin_align())
+                                        bin_align=self._bin_align(),
+                                        **margin_kw)
                 self.gbtree = GBTree(self.param, cuts)
                 if getattr(dtrain, "is_external", False):
                     # paged matrices route through the binned pipeline
@@ -518,6 +526,30 @@ class Booster:
         return 32 if _impl(self.param.hist_precision
                            ).startswith("pallas") else 0
 
+    def _announce_rank_path(self, entry) -> None:
+        """One stderr line (first boost only) naming the LambdaRank
+        gradient path chosen for the TRAINING matrix.  The group-padded
+        and sort-based device paths train numerically DIFFERENT models
+        (bf16 partner dot + lane tie-breaks vs unstable sort order —
+        metric-parity tested, bit divergence documented in
+        rank_device.py); the gate that picks between them is a
+        heuristic, so the choice must be visible without reading
+        docstrings (advisor, round 4).  Called from the boost path —
+        not the entry builder — so eval-set entries never announce and
+        the mesh-sharded branch (always sort-based) is covered too.
+        ``XGBTPU_RANK_PAD=0`` forces sort-based; ``silent=1`` mutes."""
+        if (getattr(self, "_rank_path_told", False)
+                or not self.param.objective.startswith("rank:")
+                or getattr(self.obj, "rank_impl", None) != "device"):
+            return
+        self._rank_path_told = True
+        path = ("group-padded" if entry.rank_pad_prep is not None
+                else "sort-based")
+        if int(getattr(self.param, "silent", 0)) == 0 and _is_rank0():
+            print(f"[rank] LambdaRank gradient path: {path} "
+                  "(set XGBTPU_RANK_PAD=0 to force sort-based; "
+                  "see README 'Ranking')", file=sys.stderr)
+
     def _rank_pad_ok(self, dmat) -> bool:
         """Gate for the group-padded rank layout (rank_device round 4):
         device LambdaRank, single chip, in-memory gbtree, grouped data
@@ -716,6 +748,7 @@ class Booster:
         self._lazy_init(dtrain)
         with ph("predict") as p:
             entry = self._entry(dtrain)
+            self._announce_rank_path(entry)
             self._sync_margin(entry)
             if prof:
                 p.block(entry.margin)
@@ -773,6 +806,7 @@ class Booster:
 
         self._lazy_init(dtrain)
         entry = self._entry(dtrain)
+        self._announce_rank_path(entry)
         ups = parse_updaters(self.param.updater)
 
         def fgrad():
@@ -986,11 +1020,16 @@ class Booster:
             elif getattr(self.gbtree, "exact_raw", False):
                 # exact mode routes on RAW values (no bins exist)
                 binned = self._raw_dense(data)[0]
-            elif data.num_row * max(data.num_col, 1) * 4 <= (1 << 31):
+            elif (data.num_row * max(data.num_col, 1) * 4 <= (1 << 31)
+                  and len(data.values)
+                      >= 0.25 * data.num_row * max(data.num_col, 1)):
                 # quantize ON DEVICE: the host searchsorted loop costs
                 # seconds at 1M rows where the fused compare-reduce is
                 # ~2 ms (binning.bin_dense_device); the f32 densify is
-                # the only host work left
+                # the only host work left.  Sparse inputs (<25% dense)
+                # keep the O(nnz) bin_matrix path — densifying them
+                # host-side costs more memory/transfer than the device
+                # quantize saves (advisor, round 4)
                 from xgboost_tpu.binning import bin_dense_device
                 Fm = self.gbtree.cuts.num_feature
                 Xd = data.to_dense(missing=np.nan)[:, :Fm]

@@ -13,13 +13,15 @@ fast path as the reference's ``GetLeafPosition`` shortcut
 from __future__ import annotations
 
 import functools
+import os
+import sys
 from typing import Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from xgboost_tpu.binning import CutMatrix
+from xgboost_tpu.binning import CutMatrix, _rank0
 from xgboost_tpu.config import TrainParam
 from xgboost_tpu.models.tree import (GrowConfig, TreeArrays, grow_tree,
                                      predict_leaf_binned,
@@ -28,20 +30,37 @@ from xgboost_tpu.models.tree import (GrowConfig, TreeArrays, grow_tree,
 from xgboost_tpu.ops.split import SplitConfig
 
 
+_WARNED: set = set()
+
+
+def _warn_once(key: str) -> bool:
+    if key in _WARNED:
+        return False
+    _WARNED.add(key)
+    return True
+
+
 def make_grow_config(p: TrainParam, n_bin: int) -> GrowConfig:
     split = SplitConfig(
         reg_lambda=p.reg_lambda, reg_alpha=p.reg_alpha,
         max_delta_step=p.max_delta_step, min_child_weight=p.min_child_weight,
         gamma=p.gamma, eta=p.eta, default_direction=p.default_direction)
-    hs = p.hist_subtraction
-    if hs < 0:
-        # auto: OFF.  Measured on v5e (PROFILE.md round 3): the MXU
-        # one-hot kernel's cost is per-row-tile, so subtraction only
-        # pays with row compaction — and XLA scatter/gather compaction
-        # costs 18-60 ms per level at 1M rows, an order of magnitude
-        # more than the ~5 ms/level it saves.  hist_subtraction=1
-        # forces it on (numerics tested equal; tests/test_updaters.py).
-        hs = 0
+    # Histogram subtraction: OFF, env-gated rather than a config param.
+    # Measured on v5e (PROFILE.md round 3): the MXU one-hot kernel's
+    # cost is per-row-tile, so subtraction only pays with row
+    # compaction — and XLA scatter/gather compaction costs 18-60 ms per
+    # level at 1M rows, an order of magnitude more than the ~5 ms/level
+    # it saves.  XGBTPU_HIST_SUBTRACTION=1 keeps the A/B reachable
+    # (numerics tested equal; tests/test_updaters.py); a
+    # hist_subtraction=... train param lands in extras and warns.
+    hs = os.environ.get("XGBTPU_HIST_SUBTRACTION", "0") == "1"
+    if ("hist_subtraction" in getattr(p, "extras", {})
+            and int(getattr(p, "silent", 0)) == 0
+            and _warn_once("hist_subtraction") and _rank0()):
+        print("[config] hist_subtraction is no longer a parameter "
+              "(measured ~10x slower on TPU; PROFILE.md round 3) — "
+              "ignored.  Set env XGBTPU_HIST_SUBTRACTION=1 to force "
+              "the subtraction path for kernel A/Bs.", file=sys.stderr)
     return GrowConfig(split=split, max_depth=p.max_depth, n_bin=n_bin,
                       subsample=p.subsample,
                       colsample_bytree=p.colsample_bytree,
