@@ -189,10 +189,11 @@ def compute_cuts_exact(dmat: DMatrix, max_exact_bin: int = 4096) -> CutMatrix:
     if n_capped and _rank0():
         print(f"[grow_colmaker] {n_capped}/{F} features exceed "
               f"max_exact_bin={max_exact_bin} distinct values and were "
-              "quantized to that many cuts — the distributed column-split "
-              "exact mode is approximate past the cap (single-controller "
-              "training uses the uncapped exact grower instead)",
-              file=sys.stderr)
+              "quantized to that many cuts — dsplit=row exact mode is "
+              "approximate past the cap (single-controller AND "
+              "dsplit=col training use the uncapped exact grower; the "
+              "reference itself runs histmaker, not exact, under row "
+              "split)", file=sys.stderr)
     return pack_cuts(per_feature)
 
 

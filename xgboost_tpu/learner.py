@@ -168,19 +168,23 @@ class Booster:
                                               self.param.sketch_eps,
                                               self.param.sketch_ratio)
                 elif ("grow_colmaker" in parse_updaters(self.param.updater)
-                        and self.param.dsplit in ("row", "col")):
-                    # distributed exact: cuts at every distinct value up
-                    # to max_exact_bin (under dsplit=col this is the
-                    # DistColMaker mode; under dsplit=row the reference
-                    # itself switches away from exact,
-                    # learner-inl.hpp:91-93)
+                        and self.param.dsplit == "row"):
+                    # dsplit=row exact: cuts at every distinct value up
+                    # to max_exact_bin (the reference itself switches
+                    # away from exact under row split,
+                    # learner-inl.hpp:91-93 — this quantized form is
+                    # already more than it offers there)
                     from xgboost_tpu.binning import compute_cuts_exact
                     cuts = compute_cuts_exact(dtrain,
                                               self.param.max_exact_bin)
                 elif "grow_colmaker" in parse_updaters(self.param.updater):
                     # TRUE exact-greedy (models/colmaker.py): bin-free —
                     # sorted raw-value scans at ANY cardinality; the
-                    # CutMatrix is a placeholder (nothing is quantized)
+                    # CutMatrix is a placeholder (nothing is quantized).
+                    # Under dsplit=col each shard scans its own raw
+                    # columns (colsplit.grow_tree_exact_colsplit — the
+                    # DistColMaker analog, exact at any cardinality,
+                    # round 5; previously capped at max_exact_bin cuts)
                     from xgboost_tpu.binning import CutMatrix
                     cuts = CutMatrix(
                         np.full((dtrain.num_col, 1), np.inf, np.float32),
@@ -306,8 +310,17 @@ class Booster:
                 self._cache[key] = self._make_sharded_entry(dmat)
             elif getattr(self.gbtree, "exact_raw", False):
                 # exact mode is bin-free: entries hold RAW values (NaN =
-                # missing); trees route by value comparison
-                raw, has_miss, raw_host = self._raw_dense(dmat)
+                # missing); trees route by value comparison.  Under
+                # dsplit=col the feature axis pads to the mesh with
+                # all-NaN columns ONCE per matrix, before the single
+                # device upload (they sort into the finder's trash
+                # segment regardless of has_missing and can never win
+                # a split); the host copy pads too so the rank build
+                # sees the sharded width
+                raw, has_miss, raw_host = self._raw_dense(
+                    dmat, pad_multiple=(self._col_mesh.devices.size
+                                        if self._col_mesh is not None
+                                        else 1))
                 entry = _CacheEntry(
                     dmat, raw,
                     self._base_margin_of(dmat, dmat.num_row))
@@ -632,12 +645,17 @@ class Booster:
             entry.binned_t = None if bt is None else jnp.asarray(bt)
         return entry
 
-    def _raw_dense(self, dmat):
+    def _raw_dense(self, dmat, pad_multiple: int = 1):
         """Dense raw-value matrix for exact mode (NaN = missing),
         feature-padded/truncated to the model width.  Returns
         (device matrix, has_missing, host matrix) — has_missing is a
         static per-dataset fact the exact grower specializes on; the
-        host copy feeds the one-off rank build for training matrices."""
+        host copy feeds the one-off rank build for training matrices.
+        ``pad_multiple`` additionally pads the feature axis with
+        all-NaN columns to a multiple (exact column split's shard
+        width) BEFORE the single host→device transfer; pad columns do
+        not flip has_missing (they sort into the finder's trash
+        segment regardless — see colmaker._find_exact_splits)."""
         X = dmat.to_dense(missing=np.nan)
         X = X[:, :self.num_feature]
         has_missing = bool(np.isnan(X).any())
@@ -645,6 +663,9 @@ class Booster:
             X = np.pad(X, ((0, 0), (0, self.num_feature - X.shape[1])),
                        constant_values=np.nan)
             has_missing = True
+        pad = (-X.shape[1]) % max(1, pad_multiple)
+        if pad:
+            X = np.pad(X, ((0, 0), (0, pad)), constant_values=np.nan)
         return jnp.asarray(X), has_missing, X
 
     def _replicated(self, x):

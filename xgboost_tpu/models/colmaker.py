@@ -94,25 +94,53 @@ def _blocked_cumsum(x: jax.Array, block: int = 512) -> jax.Array:
     (MXU) + a small cross-block cumsum.  XLA's native cumsum lowers to
     a log-depth multi-pass scan (~9 ms for (28, 250k) f32 on v5e); the
     blocked form runs in well under 1 ms (tools/exact_microbench.py).
-    HIGHEST precision keeps the prefix sums f32-accurate."""
+    HIGHEST precision keeps the prefix sums f32-accurate.
+
+    The triangular dot runs as a ``lax.map`` over features, NOT one
+    batched einsum: a batched dot's accumulation order varies with the
+    batch size (measured 4e-5 drift between F=13 and F=2 slices of the
+    same data on CPU), which would make per-shard column-split results
+    diverge from the single-device run.  Mapped per-feature dots have
+    a fixed (nb, block) @ (block, block) shape regardless of F, so a
+    feature's prefix sums are bitwise identical however the features
+    are sharded — the property the exact column split's bit-match
+    guarantee rests on (round 5).  Cost: same MXU work, F sequential
+    dispatches inside one compiled loop."""
     F, N = x.shape
     nb = -(-N // block)
     xb = jnp.pad(x, ((0, 0), (0, nb * block - N))).reshape(F, nb, block)
     tri = jnp.triu(jnp.ones((block, block), x.dtype))
-    within = jnp.einsum("fnj,ji->fni", xb, tri,
-                        precision=jax.lax.Precision.HIGHEST)
+    within = jax.lax.map(
+        lambda xf: jnp.dot(xf, tri, precision=jax.lax.Precision.HIGHEST),
+        xb)
     sums = xb.sum(axis=2)
     base = jnp.cumsum(sums, axis=1) - sums          # exclusive, (F, nb)
     return (within + base[:, :, None]).reshape(F, nb * block)[:, :N]
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "has_missing"))
+def _default_exact_router(best, node_of_row, X, x_missing):
+    """Row go-left by raw-value comparison when all features are local
+    (reference model.h:555-566)."""
+    F = X.shape[1]
+    f_row = table_lookup(best.feature, node_of_row)
+    thr_row = table_lookup(best.threshold, node_of_row)
+    dl_row = table_lookup(best.default_left, node_of_row)
+    sel = (jnp.arange(F, dtype=jnp.int32)[None, :]
+           == jnp.maximum(f_row, 0)[:, None])
+    x_row = jnp.where(sel, X, 0.0).sum(axis=1)
+    miss = (sel & x_missing).any(axis=1)
+    return jnp.where(miss, dl_row, x_row < thr_row)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "has_missing", "split_merge", "router", "feat_sampler"))
 def grow_tree_exact(key: jax.Array, X: jax.Array, gh: jax.Array,
                     cfg: GrowConfig,
                     row_valid: Optional[jax.Array] = None,
                     has_missing: bool = True,
                     rank_t: Optional[jax.Array] = None,
-                    uniq: Optional[jax.Array] = None
+                    uniq: Optional[jax.Array] = None,
+                    split_merge=None, router=None, feat_sampler=None
                     ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree by exact enumeration.
 
@@ -124,12 +152,29 @@ def grow_tree_exact(key: jax.Array, X: jax.Array, gh: jax.Array,
     ``rank_t``/``uniq`` (from :func:`build_exact_ranks`) enable the
     faster single-key sort; without them the finder falls back to the
     two-key (node, value) sort.
+
+    The three hooks are the column-split collective seams (the same
+    protocol as :func:`xgboost_tpu.models.tree.grow_tree`'s; supplied
+    by ``parallel/colsplit.grow_tree_exact_colsplit`` — the
+    DistColMaker analog, ``updater_distcol-inl.hpp:136-153``):
+    ``split_merge(local_best)`` reduces per-shard winners to the global
+    one; ``router(best, node_of_row, X, x_missing)`` resolves row
+    routing when the winning feature may live on another shard;
+    ``feat_sampler(key, rate, X)`` draws colsample masks shards agree
+    on.  Defaults are the single-device identities.
     Returns (tree, row_leaf) like :func:`grow_tree`.
     """
     N, F = X.shape
     D = cfg.max_depth
     xt = X.T                                         # (F, N) sort key
     miss_t = jnp.isnan(xt)
+
+    from xgboost_tpu.models.tree import _sample_features
+    if router is None:
+        router = _default_exact_router
+    if feat_sampler is None:
+        feat_sampler = (lambda k, rate, Xl:
+                        _sample_features(k, Xl.shape[1], rate))
 
     key_rows, key_ftree, key_flevel = jax.random.split(key, 3)
     gh_used = gh
@@ -139,8 +184,7 @@ def grow_tree_exact(key: jax.Array, X: jax.Array, gh: jax.Array,
     if row_valid is not None:
         gh_used = gh_used * row_valid[:, None].astype(gh.dtype)
 
-    from xgboost_tpu.models.tree import _sample_features
-    fmask_tree = _sample_features(key_ftree, F, cfg.colsample_bytree)
+    fmask_tree = feat_sampler(key_ftree, cfg.colsample_bytree, X)
 
     tree = empty_tree(D)
     pos = jnp.zeros(N, jnp.int32)
@@ -160,12 +204,14 @@ def grow_tree_exact(key: jax.Array, X: jax.Array, gh: jax.Array,
         else:
             fmask = fmask_tree
             if cfg.colsample_bylevel < 1.0:
-                fmask = fmask & _sample_features(
-                    jax.random.fold_in(key_flevel, depth), F,
-                    cfg.colsample_bylevel)
+                fmask = fmask & feat_sampler(
+                    jax.random.fold_in(key_flevel, depth),
+                    cfg.colsample_bylevel, X)
             best = _find_exact_splits(xt, miss_t, gh_used, pos, nst,
                                       n_node, fmask, cfg.split,
                                       has_missing, rank_t, uniq)
+            if split_merge is not None:
+                best = split_merge(best)
             can_try = nst[:, 1] >= 2.0 * cfg.split.min_child_weight
             do_split = best.valid & can_try
             make_leaf = ~do_split
@@ -177,18 +223,7 @@ def grow_tree_exact(key: jax.Array, X: jax.Array, gh: jax.Array,
         row_is_leaf = active & table_lookup(make_leaf, node_of_row)
         row_leaf = jnp.where(row_is_leaf, base + pos, row_leaf)
         if best is not None:
-            f_row = table_lookup(best.feature, node_of_row)
-            thr_row = table_lookup(best.threshold, node_of_row)
-            dl_row = table_lookup(best.default_left, node_of_row)
-            # raw-value routing (reference model.h:555-566)
-            x_row = jnp.where(
-                jnp.arange(F, dtype=jnp.int32)[None, :]
-                == jnp.maximum(f_row, 0)[:, None], X, 0.0).sum(axis=1)
-            miss = jnp.where(
-                jnp.arange(F, dtype=jnp.int32)[None, :]
-                == jnp.maximum(f_row, 0)[:, None],
-                x_missing, False).any(axis=1)
-            go_left = jnp.where(miss, dl_row, x_row < thr_row)
+            go_left = router(best, node_of_row, X, x_missing)
             new_pos = 2 * pos + (~go_left).astype(jnp.int32)
             pos = jnp.where(active & ~row_is_leaf, new_pos, -1)
 
@@ -226,8 +261,12 @@ def _find_exact_splits(xt, miss_t, gh_used, pos, nst, n_node: int,
     # sort: ties only occur between equal values of one node, where
     # any order yields the same boundary prefixes (stable would add an
     # internal iota tiebreak: measured 25.1 -> 21.5 ms at (28, 250k)).
-    keep = (pos >= 0)[None, :] if not has_missing \
-        else ((pos >= 0)[None, :] & ~miss_t)
+    # NaN exclusion applies even with has_missing=False: the flag
+    # elides the default-left scan + end-of-scan candidates, but the
+    # column split pads shards with all-NaN columns that must still
+    # sort into the trash segment (the mask is free when no NaN
+    # exists — miss_t is all-False)
+    keep = (pos >= 0)[None, :] & ~miss_t
     key1 = jnp.broadcast_to(jnp.where(keep, pos[None, :], M),
                             (F, N)).astype(jnp.int32)
     g_b = jnp.broadcast_to(gh_used[None, :, 0], (F, N))

@@ -144,11 +144,14 @@ class GBTree:
         self.cuts = cuts
         self.cfg = make_grow_config(param, cuts.max_bin)
         # TRUE exact-greedy mode (models/colmaker.py): bin-free raw-value
-        # pipeline, single-controller (the reference's distributed modes
-        # are histmaker for dsplit=row, DistColMaker for dsplit=col)
+        # pipeline.  Covers single-controller AND dsplit=col (the
+        # DistColMaker analog runs the same finder per feature shard —
+        # colsplit.grow_tree_exact_colsplit); only dsplit=row keeps the
+        # quantized form (the reference switches away from exact there,
+        # learner-inl.hpp:91-93)
         from xgboost_tpu.models.updaters import parse_updaters
         self.exact_raw = ("grow_colmaker" in parse_updaters(param.updater)
-                          and param.dsplit not in ("row", "col"))
+                          and param.dsplit != "row")
         self._split_finder_cache = None  # stable identity (jit static arg)
         self._trees_list: List[TreeArrays] = []  # materialized per-tree pytrees
         # stacked trees not yet sliced into _trees_list (fused rounds /
@@ -258,7 +261,8 @@ class GBTree:
         if self.exact_raw:
             return self._do_boost_exact(binned, gh, key, row_valid,
                                         do_prune, K, npar,
-                                        exact_has_missing, exact_ranks)
+                                        exact_has_missing, exact_ranks,
+                                        col_mesh=col_mesh)
         if (col_mesh is None and K * npar > 1
                 and not os.environ.get("XGBTPU_SEQ_BOOST")):
             return self._do_boost_vmapped(binned, gh, key, row_valid, mesh,
@@ -320,9 +324,12 @@ class GBTree:
 
     def _do_boost_exact(self, X, gh, key, row_valid, do_prune: bool,
                         K: int, npar: int, has_missing: bool = True,
-                        exact_ranks=None):
+                        exact_ranks=None, col_mesh=None):
         """Exact-greedy round: sequential per-tree growth (the exact
-        scans don't share a one-hot, so there is nothing to batch)."""
+        scans don't share a one-hot, so there is nothing to batch).
+        With ``col_mesh``, each shard scans its own raw columns and
+        winners reduce over the mesh — TRUE exact column split at any
+        cardinality (colsplit.grow_tree_exact_colsplit)."""
         from xgboost_tpu.models.colmaker import grow_tree_exact
         from xgboost_tpu.models.updaters import prune_tree
         from xgboost_tpu.parallel import mock
@@ -338,9 +345,18 @@ class GBTree:
                 tkey = jax.random.fold_in(key, k * npar + t)
                 rk, uq = exact_ranks if exact_ranks is not None \
                     else (None, None)
-                tree, row_leaf = grow_tree_exact(
-                    tkey, X, gh[:, k, :], self.cfg, row_valid,
-                    has_missing=has_missing, rank_t=rk, uniq=uq)
+                if col_mesh is not None:
+                    from xgboost_tpu.parallel.colsplit import \
+                        grow_tree_exact_colsplit
+                    tree, row_leaf, _ = grow_tree_exact_colsplit(
+                        col_mesh, tkey, X, gh[:, k, :], self.cfg,
+                        row_valid, has_missing=has_missing,
+                        rank_t=rk, uniq=uq,
+                        f_real=self.cuts.num_feature)
+                else:
+                    tree, row_leaf = grow_tree_exact(
+                        tkey, X, gh[:, k, :], self.cfg, row_valid,
+                        has_missing=has_missing, rank_t=rk, uniq=uq)
                 if do_prune:
                     tree, resolve = prune_tree(tree, self.param.gamma)
                     d = table_lookup(tree.leaf_value[jnp.asarray(resolve)],
