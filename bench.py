@@ -28,9 +28,17 @@ pod-vs-socket wall-clock ratio under (generous) linear CPU scaling —
 the BASELINE.md target is >= 10.  Fallback when PARITY.json is absent:
 the pre-measurement estimate 8e4 rows/s.
 
+Round 5 widens the driver-visible surface (VERDICT r4 items 4-6):
+``predict_rows_per_sec`` fields pin the prediction fast paths; the
+``otto`` (200k x 93, 9-class softprob — f_tile < F kernel tiling) and
+``yearpred`` (500k x 90 regression) workloads time previously-untimed
+kernel paths; ``extmem`` forces the over-budget STREAMING
+external-memory path and reports rounds/s + staged MB/s.
+
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
 "multiclass_ms_per_round", "rank_rounds_per_sec", ...}.
-``BENCH_WORKLOADS`` (comma list of binary,multiclass,rank) trims it.
+``BENCH_WORKLOADS`` (comma list of binary,multiclass,rank,otto,
+yearpred,extmem) trims it.
 """
 
 import json
@@ -88,6 +96,24 @@ def _time_training(xgb, params, d, rounds):
     return dt, bst
 
 
+def _time_predict(bst, make_dmat, n_rows):
+    """Best-of-reps one-off prediction timing (predict returns a host
+    numpy array, so the pull is the barrier).  A FRESH DMatrix per rep
+    exercises the uncached path — device-side quantization + level-
+    local traversal (the round-4 fast paths this guards; reference
+    headline harness times the full train+predict cycle,
+    demo/kaggle-higgs/speedtest.py:44-60)."""
+    bst.predict(make_dmat())                     # warm the jit caches
+    dt = float("inf")
+    for _ in range(int(os.environ.get("BENCH_REPS", 3))):
+        d = make_dmat()
+        t0 = time.perf_counter()
+        p = bst.predict(d)
+        dt = min(dt, time.perf_counter() - t0)
+        assert p.shape[0] == n_rows
+    return n_rows / dt
+
+
 def bench_multiclass():
     """6-class softmax, 200k x 28, depth 6 (demo/multiclass_classification
     shape scaled up; exercises the vmapped ensemble growth).  Returns
@@ -107,7 +133,115 @@ def bench_multiclass():
     dt, bst = _time_training(xgb, params, d, rounds)
     pred = bst.predict(dte)
     merror = float((pred != y[n:]).mean())
-    return dt / (rounds - 1) * 1e3, merror
+    pred_rps = _time_predict(
+        bst, lambda: xgb.DMatrix(X[:n]), n)
+    return dt / (rounds - 1) * 1e3, merror, pred_rps
+
+
+def bench_otto():
+    """9-class softprob, 200k x 93, depth 6 (demo/kaggle-otto shape:
+    otto_train_pred.py trains softprob on 93 features / 9 classes).
+    Exercises the f_tile < F feature-tiling path of the pallas
+    histogram kernel (first taken at F > 64 with B = 64) and wide-K
+    vmapped ensemble growth — both untimed by the main workloads
+    (VERDICT r4 Weak #4).  Returns (ms_per_round, mlogloss)."""
+    import xgboost_tpu as xgb
+
+    n, f, k, rounds = 200_000, 93, 9, 60
+    rng = np.random.RandomState(21)
+    X = rng.rand(n + 20_000, f).astype(np.float32) ** 2   # otto counts skew
+    centers = rng.randn(k, f).astype(np.float32)
+    logits = X @ centers.T + 0.5 * rng.randn(n + 20_000, k)
+    y = logits.argmax(axis=1).astype(np.float32)
+    d = xgb.DMatrix(X[:n], label=y[:n])
+    dte = xgb.DMatrix(X[n:], label=y[n:])
+    params = {"objective": "multi:softprob", "num_class": k,
+              "max_depth": 6, "eta": 0.3, "max_bin": 64}
+    dt, bst = _time_training(xgb, params, d, rounds)
+    p = np.asarray(bst.predict(dte)).reshape(-1, k)
+    yi = y[n:].astype(np.int64)
+    mll = float(-np.mean(np.log(np.clip(p[np.arange(len(yi)), yi],
+                                        1e-15, 1.0))))
+    return dt / (rounds - 1) * 1e3, mll
+
+
+def bench_yearpred():
+    """Squared-error regression, 500k x 90, depth 6 (demo/yearpredMSD
+    shape: 90 audio features, year target).  Exercises the same wide-F
+    kernel tiling single-output — the regression family is otherwise
+    driver-invisible.  Returns (rounds_per_sec, rmse)."""
+    import xgboost_tpu as xgb
+
+    n, f, rounds = 500_000, 90, 60
+    rng = np.random.RandomState(31)
+    X = rng.randn(n + 50_000, f).astype(np.float32)
+    yr = (1998.0 + 8.0 * np.tanh(X[:, 0] + 0.5 * X[:, 1] * X[:, 2])
+          + 2.0 * rng.randn(n + 50_000)).astype(np.float32)
+    d = xgb.DMatrix(X[:n], label=yr[:n])
+    dte = xgb.DMatrix(X[n:], label=yr[n:])
+    params = {"objective": "reg:linear", "max_depth": 6, "eta": 0.3,
+              "max_bin": 64, "base_score": float(yr[:n].mean())}
+    dt, bst = _time_training(xgb, params, d, rounds)
+    pred = np.asarray(bst.predict(dte))
+    rmse = float(np.sqrt(np.mean((pred - yr[n:]) ** 2)))
+    return (rounds - 1) / dt, rmse
+
+
+def bench_extmem():
+    """STREAMING external-memory training: the bench config (1M x 28,
+    depth 6) forced over-budget with a 16 MB device cache so every
+    level streams binned batches host→device (the out-of-HBM path —
+    in-budget matrices collapse to the in-memory fast path and never
+    exercise it; VERDICT r4 Missing #4).  Background prefetch
+    (external._prefetch_to_device) overlaps batch staging with device
+    compute; the A/B against synchronous staging is in PROFILE.md.
+    Returns (rounds_per_sec, staged_MB_per_sec, auc).  Reference
+    counterpart: page_dmatrix-inl.hpp:20-60 prints ingest MB/s at
+    runtime (:172-177)."""
+    import shutil
+    import tempfile
+    import xgboost_tpu as xgb
+    from xgboost_tpu import metrics as M
+    from xgboost_tpu.external import ExtMemDMatrix
+
+    n, rounds = 1_000_000, 6
+    X, y = make_higgs_like(n + 100_000)
+    cache = os.path.join(tempfile.mkdtemp(prefix="xgbtpu_bench_ext_"), "m")
+
+    def chunks():
+        for s in range(0, n, 1 << 18):
+            yield X[s:s + (1 << 18)], y[s:s + (1 << 18)]
+
+    # 256k-row pages: the tunnel-attached chip pays ~100 ms RTT per
+    # upload, so batches amortize it (7.3 MB each at 33 MB/s measured)
+    d = ExtMemDMatrix(chunks(), cache=cache, page_rows=1 << 18)
+    params = {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
+              "max_bin": 64}
+    old = os.environ.get("XGTPU_EXT_DEVICE_CACHE_MB")
+    os.environ["XGTPU_EXT_DEVICE_CACHE_MB"] = "16"
+    try:
+        bst = xgb.Booster(params, cache=[d])
+        bst.update(d, 0)                       # compile + first round
+        _barrier_entry(bst, d)
+        t0 = time.perf_counter()
+        for i in range(1, rounds):
+            bst.update(d, i)
+        _barrier_entry(bst, d)
+        dt = time.perf_counter() - t0
+    finally:
+        if old is None:
+            os.environ.pop("XGTPU_EXT_DEVICE_CACHE_MB", None)
+        else:
+            os.environ["XGTPU_EXT_DEVICE_CACHE_MB"] = old
+    rps = (rounds - 1) / dt
+    # bytes staged per round: every non-terminal level re-streams the
+    # whole binned matrix (+ the per-round delta/margin pass)
+    staged_mb = (n * 28 * (6 + 1)) / 1e6
+    auc = M.auc(bst.predict(xgb.DMatrix(X[n:], label=y[n:])), y[n:],
+                np.ones(100_000, np.float32))
+    del d, bst     # release the memmap before removing its backing dir
+    shutil.rmtree(os.path.dirname(cache), ignore_errors=True)
+    return rps, staged_mb * rps, float(auc)
 
 
 def bench_rank():
@@ -135,10 +269,25 @@ def bench_rank():
 
 
 def main():
+    if not os.environ.get("XGBTPU_NO_JITCACHE"):
+        # repo-local persistent jit cache (same mechanism the CLI uses
+        # for warm-cache recovery, cli.py:147-162): bench compiles are
+        # ~60 s each through the tunnel and identical run to run —
+        # notably the 8 per-level executables of the streamed extmem
+        # workload — so later runs (the driver's) reload instead of
+        # recompiling
+        import jax
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".jitcache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
     n_rounds = int(os.environ.get("BENCH_ROUNDS", 100))
     workloads = [w.strip() for w in os.environ.get(
-        "BENCH_WORKLOADS", "binary,multiclass,rank").split(",")]
+        "BENCH_WORKLOADS",
+        "binary,multiclass,rank,otto,yearpred,extmem").split(",")]
     import xgboost_tpu as xgb
     from xgboost_tpu import metrics
 
@@ -170,21 +319,40 @@ def main():
                 measured = json.load(f).get("baseline_1m", {})
             baseline_rows_per_sec = measured.get("rows_per_sec_1thread",
                                                  baseline_rows_per_sec)
+        # one-off 100-tree prediction on the full training shape (the
+        # round-4 prediction fast paths: device quantize + level-local
+        # traversal) — driver-visible so they can't silently regress
+        pred_rps = _time_predict(bst, lambda: xgb.DMatrix(Xtr), n_rows)
         out = {
             "metric": "higgs1m_train_rows_per_sec_per_chip",
             "value": round(rows_per_sec, 1),
             "unit": f"rows/s (depth6 x {n_rounds} rounds, 1 chip; "
                     f"auc={auc:.4f}, rounds/s={rounds_per_sec:.2f})",
             "vs_baseline": round(rows_per_sec / baseline_rows_per_sec, 2),
+            "predict_rows_per_sec": round(pred_rps, 1),
         }
     if "multiclass" in workloads:
-        mc_ms, mc_err = bench_multiclass()
+        mc_ms, mc_err, mc_prps = bench_multiclass()
         out["multiclass_ms_per_round"] = round(mc_ms, 2)
         out["multiclass_merror"] = round(mc_err, 4)
+        out["multiclass_predict_rows_per_sec"] = round(mc_prps, 1)
     if "rank" in workloads:
         rk_rps, rk_ndcg = bench_rank()
         out["rank_rounds_per_sec"] = round(rk_rps, 2)
         out["rank_ndcg"] = round(rk_ndcg, 4)
+    if "otto" in workloads:
+        ot_ms, ot_mll = bench_otto()
+        out["otto_ms_per_round"] = round(ot_ms, 2)
+        out["otto_mlogloss"] = round(ot_mll, 4)
+    if "yearpred" in workloads:
+        yp_rps, yp_rmse = bench_yearpred()
+        out["yearpred_rounds_per_sec"] = round(yp_rps, 2)
+        out["yearpred_rmse"] = round(yp_rmse, 4)
+    if "extmem" in workloads:
+        ex_rps, ex_mbs, ex_auc = bench_extmem()
+        out["extmem_stream_rounds_per_sec"] = round(ex_rps, 3)
+        out["extmem_staged_mb_per_sec"] = round(ex_mbs, 1)
+        out["extmem_auc"] = round(ex_auc, 4)
     print(json.dumps(out))
 
 

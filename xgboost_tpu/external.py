@@ -14,7 +14,9 @@ TPU-native shape of the same idea (SURVEY.md §5.7):
      never fully resident;
   4. per tree level, batches of binned rows are staged host→device,
      positions recomputed by partial traversal, and partial histograms
-     accumulated — working set is one batch (the reference builds
+     accumulated — working set is a handful of page_rows batches (one
+     synchronously; up to four with the default prefetcher — see
+     ``device_batches``), never the data size (the reference builds
      histograms col-batch by col-batch for the same reason,
      ``updater_histmaker-inl.hpp:296-348``).
 
@@ -339,7 +341,9 @@ class ExtMemDMatrix:
         The learner then trains through the in-memory fast path —
         external memory has done its job bounding INGEST/sketch/quantize
         memory — and only genuinely over-budget matrices stream batches
-        (the out-of-HBM guarantee: working set is one batch).
+        (the out-of-HBM guarantee: working set is a few page_rows
+        batches — up to four with the default prefetcher, one with
+        ``XGTPU_EXT_PREFETCH=0``).
 
         Budget: ``XGTPU_EXT_DEVICE_CACHE_MB`` when set; otherwise HALF
         of the device's currently-free memory (ADVICE r2: a fixed
@@ -358,9 +362,70 @@ class ExtMemDMatrix:
 
     def device_batches(self):
         """Yield (row_start, binned_device) batches (streaming; the
-        in-budget case never reaches here — see fits_device_budget)."""
-        for start, b in self.binned_batches():
-            yield start, jnp.asarray(b)
+        in-budget case never reaches here — see fits_device_budget).
+
+        Batches are staged by a background prefetch thread (depth-2
+        queue): the memmap read + host→device upload of batch i+1
+        overlaps the device compute on batch i — the reference's
+        ThreadBuffer idea (``utils/thread_buffer.h``) at the device
+        boundary.  The streamed working set is then up to FOUR batches
+        device-resident (yielded + 2 queued + 1 in-flight put) instead
+        of one — still bounded by page_rows, never by data size; the
+        default budget's free-HBM halving covers it
+        (:func:`_default_device_budget`).  ``XGTPU_EXT_PREFETCH=0``
+        restores synchronous single-batch staging (the A/B seam and
+        the fallback for batches sized near free HBM; round-5
+        measurement in PROFILE.md)."""
+        if os.environ.get("XGTPU_EXT_PREFETCH", "1") == "0":
+            for start, b in self.binned_batches():
+                yield start, jnp.asarray(b)
+            return
+        yield from _prefetch_to_device(self.binned_batches())
+
+
+def _prefetch_to_device(batches, depth: int = 2):
+    """Stage (start, np_batch) pairs to the device from a worker thread,
+    ``depth`` batches ahead.  jax.device_put is thread-safe; the
+    consumer's compute dispatches interleave with the worker's uploads
+    on the host side, and the device runtime orders them on its stream.
+    Exceptions propagate to the consumer."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for start, b in batches:
+                if stop.is_set():
+                    return
+                q.put((start, jax.device_put(b)))
+            q.put(_END)
+        except BaseException as e:  # noqa: BLE001 - relayed to consumer
+            q.put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # early-closed generator: unblock + retire the worker so its
+        # memmap reads don't outlive the matrix
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5.0)
 
 
 _budget_cache: Optional[int] = None
