@@ -275,3 +275,38 @@ def test_ext_in_budget_collapses_to_in_memory_path(tmp_path, monkeypatch):
     np.testing.assert_allclose(p1, p2, rtol=2e-4, atol=2e-5)
     err = ((p1 > 0.5) != (y > 0.5)).mean()
     assert err < 0.1, err
+
+
+def test_prefetch_to_device_exception_and_early_close():
+    """The streaming prefetcher (external._prefetch_to_device) must
+    relay producer exceptions to the consumer and retire its worker
+    thread when the consumer stops early (round 5)."""
+    import threading
+
+    from xgboost_tpu.external import _prefetch_to_device
+
+    # normal drain preserves order and content
+    batches = [(i, np.full((4,), i, np.uint8)) for i in range(5)]
+    got = list(_prefetch_to_device(iter(batches)))
+    assert [s for s, _ in got] == [0, 1, 2, 3, 4]
+    for (_, a), (_, b) in zip(batches, got):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+    # producer exception surfaces at the consumer
+    def bad():
+        yield 0, np.zeros(4, np.uint8)
+        raise RuntimeError("disk gone")
+
+    it = _prefetch_to_device(bad())
+    next(it)
+    with pytest.raises(RuntimeError, match="disk gone"):
+        next(it)
+
+    # early close joins the worker (no leaked alive threads)
+    before = {t.ident for t in threading.enumerate()}
+    it = _prefetch_to_device(iter(batches))
+    next(it)
+    it.close()
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in before and t.is_alive()]
+    assert not leaked, leaked
