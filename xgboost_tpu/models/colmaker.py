@@ -96,26 +96,31 @@ def _blocked_cumsum(x: jax.Array, block: int = 512) -> jax.Array:
     blocked form runs in well under 1 ms (tools/exact_microbench.py).
     HIGHEST precision keeps the prefix sums f32-accurate.
 
-    The triangular dot runs as a ``lax.map`` over features, NOT one
-    batched einsum: a batched dot's accumulation order varies with the
-    batch size (measured 4e-5 drift between F=13 and F=2 slices of the
-    same data on CPU), which would make per-shard column-split results
-    diverge from the single-device run.  Mapped per-feature dots have
-    a fixed (nb, block) @ (block, block) shape regardless of F, so a
-    feature's prefix sums are bitwise identical however the features
-    are sharded — the property the exact column split's bit-match
-    guarantee rests on (round 5).  Cost: same MXU work, F sequential
-    dispatches inside one compiled loop."""
+    The ENTIRE per-feature computation — triangular dot, block sums,
+    cross-block base, add — runs inside one ``lax.map`` body over
+    features, NOT as F-batched ops: batched accumulation order varies
+    with the batch size (measured 4e-5 drift between F=13 and F=2
+    slices on CPU; 2.4e-4 on TPU when only the dot was mapped and the
+    block-sum/cumsum stayed batched), which would make per-shard
+    column-split results diverge from the single-device run.  The map
+    body has a fixed (nb, block) shape regardless of F, so a feature's
+    prefix sums are bitwise identical however the features are sharded
+    — verified on BOTH backends; the exact column split's bit-match
+    guarantee rests on it (round 5).  Cost: same MXU work, F
+    sequential dispatches inside one compiled loop (measured
+    kernel-neutral at (28, 250k) on v5e)."""
     F, N = x.shape
     nb = -(-N // block)
     xb = jnp.pad(x, ((0, 0), (0, nb * block - N))).reshape(F, nb, block)
     tri = jnp.triu(jnp.ones((block, block), x.dtype))
-    within = jax.lax.map(
-        lambda xf: jnp.dot(xf, tri, precision=jax.lax.Precision.HIGHEST),
-        xb)
-    sums = xb.sum(axis=2)
-    base = jnp.cumsum(sums, axis=1) - sums          # exclusive, (F, nb)
-    return (within + base[:, :, None]).reshape(F, nb * block)[:, :N]
+
+    def per_feature(xf):                          # (nb, block)
+        w = jnp.dot(xf, tri, precision=jax.lax.Precision.HIGHEST)
+        s = xf.sum(axis=1)
+        base = jnp.cumsum(s) - s                  # exclusive, (nb,)
+        return w + base[:, None]
+
+    return jax.lax.map(per_feature, xb).reshape(F, nb * block)[:, :N]
 
 
 def _default_exact_router(best, node_of_row, X, x_missing):
