@@ -2,7 +2,7 @@
 
 Mirrors the reference CLI (``src/xgboost_main.cpp:19-323``): a config
 file of ``name = value`` pairs plus command-line overrides, dispatching
-``task=train|pred|eval|dump``.  Parameter names are kept identical
+``task=train|pred|eval|dump|serve``.  Parameter names are kept identical
 (``num_round``, ``save_period``, ``model_in``, ``model_out``,
 ``model_dir``, ``eval[name]=path``, ``test:data``, ``name_pred``,
 ``pred_margin``, ``ntree_limit``, ``fmap``, ``name_dump``,
@@ -25,9 +25,24 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
-from xgboost_tpu.config import parse_config_file
+from xgboost_tpu.config import SERVE_PARAMS, parse_config_file
 
 _T0 = time.time()  # process start, for recovery-cost accounting
+
+_USAGE = """\
+Usage: python -m xgboost_tpu <config> [name=value ...]
+
+Tasks (task=...):
+  train   train a model (data=..., num_round=..., model_out=...)
+  pred    write predictions (model_in=..., test:data=..., name_pred=...)
+  eval    print eval metrics (model_in=..., eval[name]=path)
+  dump    dump trees as text (model_in=..., name_dump=...)
+  serve   HTTP prediction service (model_in=...; see parameters below,
+          or `python -m xgboost_tpu.serving --help`)
+
+task=serve parameters:
+{serve_params}
+"""
 
 
 class BoostLearnTask:
@@ -62,6 +77,8 @@ class BoostLearnTask:
         self.eval_names: List[str] = []
         self.eval_paths: List[str] = []
         self.learner_params: List[Tuple[str, str]] = []
+        # task=serve knobs, seeded from config.SERVE_PARAMS defaults
+        self.serve_params = {k: v for k, (v, _) in SERVE_PARAMS.items()}
 
     # ------------------------------------------------------------- params
     _OWN = {
@@ -115,6 +132,8 @@ class BoostLearnTask:
                 self.mock_spec.append(tuple(nums))
         elif name == "keepalive":
             self.keepalive = int(val)
+        elif name in self.serve_params:
+            self.serve_params[name] = type(SERVE_PARAMS[name][0])(val)
         else:
             m = re.match(r"eval\[([^\]]+)\]", name)
             if m:
@@ -128,7 +147,8 @@ class BoostLearnTask:
     # --------------------------------------------------------------- run
     def run(self, argv: List[str]) -> int:
         if not argv:
-            print("Usage: python -m xgboost_tpu <config> [name=value ...]")
+            from xgboost_tpu.config import serve_params_help
+            print(_USAGE.format(serve_params=serve_params_help()))
             return 0
         if os.path.exists(argv[0]) or "=" not in argv[0]:
             for name, val in parse_config_file(argv[0]):
@@ -235,6 +255,8 @@ class BoostLearnTask:
             return self.task_eval()
         if self.task == "dump":
             return self.task_dump()
+        if self.task == "serve":
+            return self.task_serve()
         raise ValueError(f"unknown task {self.task!r}")
 
     # ------------------------------------------------------------- helpers
@@ -420,6 +442,28 @@ class BoostLearnTask:
                  for p, n in zip(self.eval_paths, self.eval_names)]
         bst = self._make_booster(cache=[d for d, _ in evals])
         print(bst.eval_set(evals, 0), file=sys.stderr)
+        return 0
+
+    # -------------------------------------------------------------- serve
+    def task_serve(self) -> int:
+        """Run the HTTP prediction service on model_in (the serving
+        subsystem; quickstart in README 'Serving', design in SERVING.md).
+        """
+        assert self.model_in, "model_in not specified"
+        from xgboost_tpu.serving import run_server
+        sp = self.serve_params
+        run_server(
+            self.model_in,
+            host=sp["serve_host"], port=sp["serve_port"],
+            min_bucket=sp["serve_min_bucket"],
+            max_bucket=sp["serve_max_bucket"],
+            max_batch_rows=sp["serve_max_batch_rows"],
+            max_wait_ms=sp["serve_max_wait_ms"],
+            max_queue_rows=sp["serve_queue_rows"],
+            poll_sec=sp["serve_poll_sec"],
+            keep_versions=sp["serve_keep_versions"],
+            warmup=bool(sp["serve_warmup"]),
+            quiet=self.silent != 0, block=True)
         return 0
 
     # -------------------------------------------------------------- dump

@@ -222,6 +222,40 @@ def _coerce(value: Any, current: Any) -> Any:
     return value
 
 
+# ------------------------------------------------------------- serving
+# task=serve parameters (xgboost_tpu.serving).  Single source of truth:
+# the classic CLI (``python -m xgboost_tpu task=serve serve_port=...``)
+# and the module runner (``python -m xgboost_tpu.serving --port ...``)
+# both derive their surfaces from this table, so ``--help``-style
+# discovery stays complete as knobs are added.  Values are
+# (default, help); the default's type drives coercion.
+SERVE_PARAMS: Dict[str, Tuple[Any, str]] = {
+    "serve_host": ("127.0.0.1", "bind address for the HTTP server"),
+    "serve_port": (8080, "HTTP port (0 = ephemeral, printed at startup)"),
+    "serve_min_bucket": (8, "smallest power-of-two row bucket"),
+    "serve_max_bucket": (8192, "largest row bucket; bigger requests are "
+                               "chunked through it"),
+    "serve_max_batch_rows": (1024, "max rows coalesced into one device "
+                                   "call by the micro-batcher"),
+    "serve_max_wait_ms": (2.0, "micro-batch window: how long the first "
+                               "request waits for company"),
+    "serve_queue_rows": (8192, "bounded queue size in rows; overflow "
+                               "rejects with HTTP 503"),
+    "serve_poll_sec": (1.0, "model-file hot-reload poll interval "
+                            "(0 disables watching)"),
+    "serve_keep_versions": (2, "previous model versions kept warm for "
+                               "instant rollback"),
+    "serve_warmup": (1, "pre-compile every row bucket at startup "
+                        "(recompile-free steady state)"),
+}
+
+
+def serve_params_help() -> str:
+    """One line per task=serve parameter, for CLI usage text."""
+    return "\n".join(f"  {name:<22} {help_} (default {default!r})"
+                     for name, (default, help_) in SERVE_PARAMS.items())
+
+
 def parse_config_file(path: str) -> List[Tuple[str, str]]:
     """Parse a ``name = value`` config file.
 

@@ -973,8 +973,15 @@ class Booster:
     def predict(self, data: DMatrix, output_margin: bool = False,
                 ntree_limit: int = 0, pred_leaf: bool = False) -> np.ndarray:
         """(reference BoostLearner::Predict, learner-inl.hpp:332-346 and
-        Booster.predict, wrapper/xgboost.py:422-450)."""
+        Booster.predict, wrapper/xgboost.py:422-450).
+
+        ``data`` may also be a plain 2-D ndarray / jax.Array / nested
+        list (NaN = missing): it is wrapped into a transient DMatrix
+        here, so callers (serving engine, sklearn wrapper) don't each
+        re-implement the wrapping."""
         assert self.gbtree is not None, "model not trained/loaded"
+        if not hasattr(data, "num_row"):  # any DMatrix flavor has it
+            data = DMatrix(np.asarray(data, dtype=np.float32))
         if getattr(data, "is_sharded", False):
             # split-loaded matrix: each process returns predictions for
             # ITS OWN rows only (no host holds the full output)

@@ -593,9 +593,14 @@ class GBTree:
 
     # -------------------------------------------------------------- predict
     def _stack(self, ntree_limit: int = 0):
-        """Stack trees (optionally first ntree_limit) into (T, ...) arrays."""
-        T = self.num_trees if ntree_limit == 0 else min(
-            ntree_limit, self.num_trees)
+        """Stack trees (optionally first ntree_limit) into (T, ...) arrays.
+
+        ``ntree_limit`` is CLAMPED to [0, num_trees] rather than
+        validated: a hot-reloaded smaller model can race a stale request
+        parameter (serving registry swap), and the reference likewise
+        treats out-of-range limits as "all trees"."""
+        T = self.num_trees if ntree_limit <= 0 else min(
+            int(ntree_limit), self.num_trees)
         if self._stack_cache is not None and self._stack_cache[0] == T:
             return self._stack_cache[1], self._stack_cache[2]
         assert T > 0, "model is empty"
@@ -622,7 +627,11 @@ class GBTree:
                             first_group: int = 0,
                             root: Optional[jax.Array] = None) -> jax.Array:
         """Add the contribution of freshly grown trees to a cached margin
-        (fixed shapes per round -> single compilation)."""
+        (fixed shapes per round -> single compilation).  An empty
+        ``new_trees`` is a no-op (a stale caller can observe zero fresh
+        trees when racing a model swap)."""
+        if not new_trees:
+            return margin
         K = max(1, self.param.num_output_group)
         npar = max(1, self.param.num_parallel_tree)
         stack = jax.tree.map(lambda *xs: jnp.stack(xs), *new_trees)

@@ -1,4 +1,6 @@
-"""Per-round timing breakdown + jax.profiler trace capture.
+"""Per-round timing breakdown + jax.profiler trace capture, plus the
+Prometheus-style serving metrics (:class:`ServingMetrics`) consumed by
+``xgboost_tpu.serving``'s ``GET /metrics`` endpoint.
 
 The analog of the reference's ``report_stats`` accounting
 (``subtree/rabit/src/allreduce_mock.h:52-56,87-95``: per-version
@@ -19,9 +21,11 @@ report_stats idea".  Two levels:
 
 from __future__ import annotations
 
+import bisect
+import threading
 import time
 from collections import defaultdict
-from typing import Optional
+from typing import Dict, Optional, Sequence, Tuple
 
 
 class RoundProfiler:
@@ -132,3 +136,211 @@ class _Phase:
                 cur["phases"].get(self.name, 0.0)
                 + time.perf_counter() - self.t0)
         return False
+
+
+# --------------------------------------------------------------- serving
+# Prometheus-style metric primitives for the serving subsystem.  These
+# follow the RoundProfiler conventions — named per-phase accounting,
+# render() as the print_summary analog — but expose the text exposition
+# format a scraper expects instead of stderr lines.
+
+# latency buckets in seconds: 0.5ms .. 5s, roughly x2 per step
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+# batch-size buckets in rows: powers of two
+_ROWS_BUCKETS = tuple(float(1 << i) for i in range(15))
+
+
+class Counter:
+    """Monotonic counter (Prometheus ``counter``)."""
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name, self.help = name, help_text
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._v += v
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {_fmt(self._v)}\n")
+
+
+class Gauge:
+    """Settable value (Prometheus ``gauge``)."""
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name, self.help = name, help_text
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._v += v
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {_fmt(self._v)}\n")
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus ``histogram``) with quantile
+    estimation by linear interpolation within the winning bucket —
+    enough resolution for p50/p99 gauges on the metrics page."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: Sequence[float] = _LATENCY_BUCKETS):
+        self.name, self.help = name, help_text
+        self.bounds = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, x: float) -> None:
+        i = bisect.bisect_left(self.bounds, x)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += x
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Approximate q-quantile (0..1) from the bucket counts."""
+        with self._lock:
+            n = self._n
+            counts = list(self._counts)
+        if n == 0:
+            return 0.0
+        target = q * n
+        cum = 0.0
+        for i, c in enumerate(counts):
+            prev = cum
+            cum += c
+            if cum >= target:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else lo
+                if c == 0 or hi <= lo:
+                    return hi
+                return lo + (hi - lo) * (target - prev) / c
+        return self.bounds[-1]
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        cum = 0
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._n, self._sum
+        for bound, c in zip(self.bounds, counts):
+            cum += c
+            lines.append(f'{self.name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{self.name}_sum {_fmt(s)}")
+        lines.append(f"{self.name}_count {total}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    return f"{int(v)}" if float(v).is_integer() else repr(float(v))
+
+
+class ServingMetrics:
+    """Metric registry for the serving subsystem (see SERVING.md for the
+    full schema).  One instance is shared by engine + batcher + registry
+    + HTTP front end; :meth:`render` produces the ``GET /metrics`` body.
+    """
+
+    def __init__(self, prefix: str = "xgbtpu_serving"):
+        self.prefix = prefix
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        p = prefix
+        self.requests = self.counter(
+            f"{p}_requests_total", "prediction requests received")
+        self.rows = self.counter(
+            f"{p}_rows_total", "real (caller-supplied) rows predicted")
+        self.padded_rows = self.counter(
+            f"{p}_padded_rows_total",
+            "padding rows added to reach the shape bucket")
+        self.rejected = self.counter(
+            f"{p}_rejected_total", "requests rejected with QueueFull (503)")
+        self.errors = self.counter(
+            f"{p}_errors_total", "requests that raised during prediction")
+        self.batches = self.counter(
+            f"{p}_batches_total", "coalesced device batches executed")
+        self.compiles = self.counter(
+            f"{p}_compiles_total", "predict executables compiled")
+        self.reloads = self.counter(
+            f"{p}_reloads_total", "successful model hot-reloads")
+        self.reload_errors = self.counter(
+            f"{p}_reload_errors_total", "failed model reload attempts")
+        self.queue_rows = self.gauge(
+            f"{p}_queue_rows", "rows currently waiting in the batch queue")
+        self.model_version = self.gauge(
+            f"{p}_model_version", "monotonic version of the served model")
+        self.batch_rows = self.histogram(
+            f"{p}_batch_rows", "rows per coalesced device batch",
+            _ROWS_BUCKETS)
+        self.latency = self.histogram(
+            f"{p}_latency_seconds",
+            "request latency, submit to result (includes queueing)")
+
+    # ------------------------------------------------------- constructors
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._register(Counter(name, help_text))
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._register(Gauge(name, help_text))
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Sequence[float] = _LATENCY_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_text, buckets))
+
+    def _register(self, m):
+        with self._lock:
+            if m.name in self._metrics:
+                return self._metrics[m.name]
+            self._metrics[m.name] = m
+            return m
+
+    # ------------------------------------------------------------- render
+    def quantiles(self, qs: Tuple[float, ...] = (0.5, 0.99)
+                  ) -> Dict[float, float]:
+        return {q: self.latency.quantile(q) for q in qs}
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        parts = [m.render() for m in metrics]
+        # p50/p99 latency as plain gauges (scrapers that don't do
+        # histogram_quantile still get the headline numbers)
+        for q, label in ((0.5, "p50"), (0.99, "p99")):
+            v = self.latency.quantile(q)
+            name = f"{self.prefix}_latency_{label}_seconds"
+            parts.append(f"# HELP {name} {label} request latency\n"
+                         f"# TYPE {name} gauge\n{name} {_fmt(v)}\n")
+        return "".join(parts)

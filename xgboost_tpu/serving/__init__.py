@@ -1,0 +1,32 @@
+"""xgboost_tpu.serving — batched, recompile-free prediction service.
+
+The L6 serving subsystem (SERVING.md): :class:`PredictEngine` owns a
+shape-bucketed cache of AOT-compiled predict executables over one
+loaded model; :class:`MicroBatcher` coalesces concurrent requests into
+single device calls with bounded-queue backpressure;
+:class:`ModelRegistry` hot-reloads a watched model path atomically with
+rollback; :class:`PredictServer` is the stdlib HTTP front end with
+``/predict``, ``/healthz`` and Prometheus ``/metrics``.
+
+Quickstart::
+
+    python -m xgboost_tpu.serving --model m.bin --port 8080
+
+or from the classic CLI: ``python -m xgboost_tpu task=serve
+model_in=m.bin serve_port=8080``.
+"""
+
+from xgboost_tpu.serving.batcher import MicroBatcher, QueueFull
+from xgboost_tpu.serving.engine import PredictEngine, power_of_two_buckets
+from xgboost_tpu.serving.http import PredictServer, run_server
+from xgboost_tpu.serving.registry import ModelRegistry
+
+__all__ = [
+    "PredictEngine",
+    "MicroBatcher",
+    "QueueFull",
+    "ModelRegistry",
+    "PredictServer",
+    "run_server",
+    "power_of_two_buckets",
+]

@@ -1,0 +1,198 @@
+"""Queue-based micro-batcher: coalesce concurrent requests into one
+device call, scatter results back to callers.
+
+Concurrent ``submit`` calls within a window (first request arms a
+``max_wait_ms`` deadline; ``max_batch_rows`` caps the coalesced size)
+are stacked into ONE engine call — the serving analog of the training
+side's "one launch per round" stance: device dispatch overhead is paid
+per batch, not per request.
+
+Backpressure is explicit: the queue is bounded in ROWS (the unit that
+costs device time/memory), and a submit that would exceed it raises
+:class:`QueueFull` immediately instead of growing memory without bound
+— the HTTP front end maps that to 503.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class QueueFull(RuntimeError):
+    """The batch queue is at capacity; retry later (HTTP 503)."""
+
+
+class _Request:
+    __slots__ = ("X", "output_margin", "done", "result", "error", "t0")
+
+    def __init__(self, X: np.ndarray, output_margin: bool):
+        self.X = X
+        self.output_margin = output_margin
+        self.done = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.t0 = time.perf_counter()
+
+
+class MicroBatcher:
+    """Coalesces concurrent predict requests into single engine calls.
+
+    Args:
+      predict_fn: callable ``(X, output_margin=...) -> np.ndarray``.
+        Resolved per BATCH, so a hot-reload between batches is picked up
+        atomically (pass ``lambda X, **kw: registry.engine.predict(X,
+        **kw)``); requests already inside a batch finish on the engine
+        the batch started with.
+      max_batch_rows: cap on rows coalesced into one device call.
+      max_wait_ms: how long the first request of a batch waits for
+        company before the batch launches anyway.
+      max_queue_rows: bound on rows waiting in the queue (backpressure).
+      metrics: optional :class:`xgboost_tpu.profiling.ServingMetrics`.
+    """
+
+    def __init__(self, predict_fn: Callable, max_batch_rows: int = 1024,
+                 max_wait_ms: float = 2.0, max_queue_rows: int = 8192,
+                 metrics=None):
+        self.predict_fn = predict_fn
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue_rows = int(max_queue_rows)
+        self.metrics = metrics
+        self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._queued_rows = 0
+        self._lock = threading.Lock()
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="xgbtpu-batcher")
+        self._worker.start()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, X, output_margin: bool = False,
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Enqueue one request and block until its predictions arrive.
+
+        Raises :class:`QueueFull` when accepting the rows would exceed
+        ``max_queue_rows`` (reject-don't-buffer backpressure)."""
+        X = np.asarray(X, np.float32)
+        if X.ndim != 2:
+            raise ValueError(f"expected 2-D rows, got shape {X.shape}")
+        n = X.shape[0]
+        if self.metrics is not None:
+            # counted BEFORE admission: "requests received" includes the
+            # ones backpressure rejects (reject ratio must be computable
+            # as rejected_total / requests_total)
+            self.metrics.requests.inc()
+        req = _Request(X, output_margin)
+        with self._lock:
+            # closed-check AND enqueue under the same lock as close()'s
+            # closed-set: a request can never land BEHIND the close
+            # sentinel (which would leave its caller blocked forever)
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            # backpressure bounds rows WAITING behind other requests.  A
+            # single oversized request is admitted when the queue is
+            # empty (the engine chunks it through the top bucket; its
+            # memory is already materialized by the caller) — otherwise
+            # a request larger than max_queue_rows would 503 forever,
+            # even on an idle server
+            if (self._queued_rows + n > self.max_queue_rows
+                    and self._queued_rows > 0):
+                if self.metrics is not None:
+                    self.metrics.rejected.inc()
+                raise QueueFull(
+                    f"queue holds {self._queued_rows} rows; adding {n} "
+                    f"exceeds max_queue_rows={self.max_queue_rows}")
+            self._queued_rows += n
+            if self.metrics is not None:
+                self.metrics.queue_rows.set(self._queued_rows)
+            self._q.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("prediction timed out")
+        if self.metrics is not None:
+            self.metrics.latency.observe(time.perf_counter() - req.t0)
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # ------------------------------------------------------------- worker
+    def _dequeue_rows(self, n: int) -> None:
+        with self._lock:
+            self._queued_rows -= n
+            if self.metrics is not None:
+                self.metrics.queue_rows.set(self._queued_rows)
+
+    def _run(self) -> None:
+        carry: Optional[_Request] = None
+        while True:
+            req = carry if carry is not None else self._q.get()
+            carry = None
+            if req is None:  # close sentinel
+                return
+            batch: List[_Request] = [req]
+            rows = req.X.shape[0]
+            deadline = time.perf_counter() + self.max_wait_ms / 1e3
+            while rows < self.max_batch_rows:
+                wait = deadline - time.perf_counter()
+                if wait <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=wait)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    carry = None
+                    self._q.put(None)  # re-arm the sentinel for after flush
+                    break
+                if (nxt.X.shape[1] != req.X.shape[1]
+                        or nxt.output_margin != req.output_margin
+                        or rows + nxt.X.shape[0] > self.max_batch_rows):
+                    # incompatible or overflowing: flush what we have,
+                    # lead the next batch with this request
+                    carry = nxt
+                    break
+                batch.append(nxt)
+                rows += nxt.X.shape[0]
+            self._flush(batch)
+
+    def _flush(self, batch: List[_Request]) -> None:
+        rows = sum(r.X.shape[0] for r in batch)
+        self._dequeue_rows(rows)
+        if self.metrics is not None:
+            self.metrics.batches.inc()
+            self.metrics.batch_rows.observe(rows)
+        try:
+            X = (batch[0].X if len(batch) == 1
+                 else np.concatenate([r.X for r in batch], axis=0))
+            out = self.predict_fn(X, output_margin=batch[0].output_margin)
+            off = 0
+            for r in batch:
+                n = r.X.shape[0]
+                r.result = out[off:off + n]
+                off += n
+        except BaseException as e:  # propagate to every caller in the batch
+            if self.metrics is not None:
+                self.metrics.errors.inc(len(batch))
+            for r in batch:
+                r.error = e
+        finally:
+            for r in batch:
+                r.done.set()
+
+    # -------------------------------------------------------------- close
+    @property
+    def queued_rows(self) -> int:
+        return self._queued_rows
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain the queue and stop the worker."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(None)  # ordered after every accepted request
+        self._worker.join(timeout)

@@ -1,0 +1,255 @@
+"""PredictEngine: recompile-free batched prediction on a loaded model.
+
+The serving core (SERVING.md): a model is loaded ONCE, its tree stack
+pinned on device, and every incoming batch is padded up to a small set
+of power-of-two row buckets so the margin computation always runs an
+already-compiled executable.  The per-bucket executables are built with
+the jax AOT API (``jit(...).lower(...).compile()``): calling a compiled
+executable can never retrace or recompile, so after :meth:`warmup` the
+steady state is zero compiles by construction (tested via
+``jax.monitoring`` compile events in tests/test_serving.py).
+
+Bitwise parity: tree traversal, margin accumulation and the objective's
+pred_transform are all row-independent, so the unpadded rows of a
+padded batch are bit-identical to ``Learner.predict`` on the same rows
+(padding rows ride along on bin 0 and are sliced off host-side).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_MIN_BUCKET = 8
+DEFAULT_MAX_BUCKET = 8192
+
+
+def power_of_two_buckets(min_bucket: int = DEFAULT_MIN_BUCKET,
+                         max_bucket: int = DEFAULT_MAX_BUCKET) -> List[int]:
+    """The default shape-bucket ladder: powers of two within
+    [min_bucket, max_bucket].  ``max_bucket`` is a HARD cap (operators
+    set it to bound device memory): a non-power-of-two max truncates
+    the ladder below it, and larger requests chunk through the top
+    bucket.  When no power of two fits the range, the single bucket is
+    ``max_bucket`` itself (buckets need not be powers of two)."""
+    if min_bucket < 1 or max_bucket < min_bucket:
+        raise ValueError(f"bad bucket range {min_bucket}:{max_bucket}")
+    b, out = 1, []
+    while b < min_bucket:
+        b <<= 1
+    while b <= max_bucket:
+        out.append(b)
+        b <<= 1
+    return out or [max_bucket]
+
+
+class PredictEngine:
+    """Batched, recompile-free prediction over one loaded model.
+
+    Args:
+      model: a model file path, raw model bytes, or a trained/loaded
+        :class:`~xgboost_tpu.learner.Booster`.
+      buckets: explicit row-bucket ladder (sorted ascending); default is
+        powers of two ``min_bucket..max_bucket``.  Requests larger than
+        the top bucket are chunked through it.
+      warmup: pre-compile (and execute once) every bucket at
+        construction so the first real request already hits the cache.
+      metrics: optional :class:`xgboost_tpu.profiling.ServingMetrics`.
+    """
+
+    def __init__(self, model, buckets: Optional[Sequence[int]] = None,
+                 min_bucket: int = DEFAULT_MIN_BUCKET,
+                 max_bucket: int = DEFAULT_MAX_BUCKET,
+                 warmup: bool = False, metrics=None):
+        from xgboost_tpu.learner import Booster
+        if isinstance(model, Booster):
+            booster = model
+        else:
+            booster = Booster()
+            if isinstance(model, (bytes, bytearray)):
+                booster.load_raw(bytes(model))
+            else:
+                booster.load_model(model)
+        if booster.gbtree is None:
+            raise ValueError("PredictEngine needs a trained/loaded model")
+        if booster.param.booster == "gblinear":
+            raise NotImplementedError(
+                "PredictEngine serves gbtree models (binned tree "
+                "traversal); gblinear predict is already a single matmul "
+                "— serve it via Learner.predict")
+        if getattr(booster.gbtree, "exact_raw", False):
+            raise NotImplementedError(
+                "exact-mode (grow_colmaker) models route on raw values; "
+                "the serving engine's binned bucket cache does not apply")
+        self.booster = booster
+        self.gbtree = booster.gbtree
+        self.obj = booster.obj
+        self.cuts = self.gbtree.cuts
+        self.num_feature = (booster.num_feature
+                            or self.cuts.num_feature)
+        self._K = max(1, booster.param.num_output_group)
+        self._max_depth = self.gbtree.cfg.max_depth
+        self._n_roots = self.gbtree.cfg.n_roots
+        self.buckets = (sorted(set(int(b) for b in buckets)) if buckets
+                        else power_of_two_buckets(min_bucket, max_bucket))
+        if self.buckets[0] < 1:
+            raise ValueError("buckets must be >= 1")
+        self.metrics = metrics
+        self.compile_count = 0          # bumped at the ONLY compile site
+        self._compiled: Dict[int, object] = {}   # bucket rows -> executable
+        self._base_cache: Dict[int, object] = {}  # bucket rows -> (B, K) base
+        self._lock = threading.Lock()
+        # device-resident model state, uploaded once
+        import jax.numpy as jnp
+        self._stack, self._group = self.gbtree._stack(0)
+        self._bin_dtype = (np.uint8 if self.cuts.max_bin <= 256
+                           else np.uint16)
+        self._base_scalar = float(
+            self.obj.prob_to_margin(booster.param.base_score))
+        self._jnp = jnp
+        if warmup:
+            self.warmup()
+
+    # ------------------------------------------------------------- buckets
+    def bucket_for(self, n_rows: int) -> int:
+        """Smallest bucket >= n_rows (the top bucket for larger batches;
+        callers chunk through it)."""
+        i = bisect_left(self.buckets, n_rows)
+        return self.buckets[min(i, len(self.buckets) - 1)]
+
+    # ------------------------------------------------------------- compile
+    def _margin_fn(self):
+        from xgboost_tpu.models.tree import predict_margin_binned
+        max_depth, K, n_roots = self._max_depth, self._K, self._n_roots
+
+        def fn(stack, group, binned, base):
+            return predict_margin_binned(stack, group, binned, base,
+                                         max_depth, K, n_roots=n_roots)
+        return fn
+
+    def _executable(self, bucket: int):
+        """The AOT-compiled margin executable for one row bucket."""
+        exe = self._compiled.get(bucket)
+        if exe is not None:
+            return exe
+        with self._lock:
+            exe = self._compiled.get(bucket)
+            if exe is not None:
+                return exe
+            import jax
+            binned_aval = jax.ShapeDtypeStruct(
+                (bucket, self.cuts.num_feature), self._bin_dtype)
+            base_aval = jax.ShapeDtypeStruct(
+                (bucket, self._K), np.float32)
+            exe = jax.jit(self._margin_fn()).lower(
+                self._stack, self._group, binned_aval, base_aval).compile()
+            self.compile_count += 1
+            if self.metrics is not None:
+                self.metrics.compiles.inc()
+            self._compiled[bucket] = exe
+            return exe
+
+    def _base_for(self, bucket: int):
+        base = self._base_cache.get(bucket)
+        if base is None:
+            base = self._jnp.full((bucket, self._K), self._base_scalar,
+                                  self._jnp.float32)
+            self._base_cache[bucket] = base
+        return base
+
+    def warmup(self) -> None:
+        """Pre-compile every bucket AND run each once end to end, so the
+        transform/eager-op caches are hot too (a reloaded model warms up
+        OFF the serving path before the registry swaps it in).
+
+        Row/padding counters are suppressed for the warmup rows — they
+        count "real (caller-supplied) rows", and a reload would
+        otherwise burst ~2x sum(buckets) phantom rows into dashboards;
+        ``compiles_total`` still counts (it is the warmup's product)."""
+        F = self.cuts.num_feature
+        saved, self.metrics = self.metrics, None
+        c0 = self.compile_count
+        try:
+            for b in self.buckets:
+                self.predict(np.zeros((b, F), np.float32))
+                self.predict(np.zeros((b, F), np.float32),
+                             output_margin=True)
+        finally:
+            self.metrics = saved
+            if saved is not None and self.compile_count > c0:
+                saved.compiles.inc(self.compile_count - c0)
+
+    # ------------------------------------------------------------- predict
+    def predict(self, X, output_margin: bool = False) -> np.ndarray:
+        """Predict a 2-D float batch; bitwise-equal to
+        ``booster.predict(DMatrix(X))`` on the supplied rows."""
+        X = np.asarray(X, np.float32)
+        if X.ndim != 2:
+            raise ValueError(f"expected 2-D rows, got shape {X.shape}")
+        if X.shape[1] > self.num_feature:
+            raise ValueError(
+                f"data has {X.shape[1]} features, model was trained "
+                f"with {self.num_feature}")
+        n = X.shape[0]
+        if n == 0:
+            # run the objective transform on a 0-row margin so the empty
+            # result's shape/dtype matches non-empty calls exactly (e.g.
+            # multi:softmax argmax squeezes to (n,), not (n, K))
+            out = np.asarray(self.obj.pred_transform(
+                self._jnp.zeros((0, self._K), self._jnp.float32),
+                output_margin=output_margin))
+            if out.ndim == 2 and out.shape[1] == 1:
+                out = out[:, 0]
+            return out
+        top = self.buckets[-1]
+        if n > top:  # chunk oversized batches through the top bucket
+            parts = [self.predict(X[i:i + top], output_margin)
+                     for i in range(0, n, top)]
+            return np.concatenate(parts, axis=0)
+        binned = self._bin(X)
+        bucket = self.bucket_for(n)
+        if bucket > n:
+            binned = np.pad(binned, ((0, bucket - n), (0, 0)))
+        if self.metrics is not None:
+            self.metrics.rows.inc(n)
+            self.metrics.padded_rows.inc(bucket - n)
+        margin = self._executable(bucket)(
+            self._stack, self._group, self._jnp.asarray(binned),
+            self._base_for(bucket))
+        # the transform runs OUTSIDE the compiled margin executable, via
+        # the objective's own (row-independent) ops — the exact functions
+        # Learner.predict dispatches, so rounding matches bit for bit
+        out = np.asarray(self.obj.pred_transform(
+            margin, output_margin=output_margin))[:n]
+        if out.ndim == 2 and out.shape[1] == 1:
+            out = out[:, 0]
+        return out
+
+    # ------------------------------------------------------------- binning
+    def _bin(self, X: np.ndarray) -> np.ndarray:
+        """Host-side quantization of dense float rows (NaN = missing ->
+        bin 0), width-padded to the model's feature count."""
+        from xgboost_tpu.binning import bin_matrix
+        from xgboost_tpu.data import DMatrix
+        if X.shape[1] < self.num_feature:
+            X = np.pad(X, ((0, 0), (0, self.num_feature - X.shape[1])),
+                       constant_values=np.nan)
+        return bin_matrix(DMatrix(X), self.cuts)
+
+    # ------------------------------------------------------------- info
+    @property
+    def num_compiled(self) -> int:
+        return len(self._compiled)
+
+    def describe(self) -> dict:
+        return {
+            "buckets": list(self.buckets),
+            "compiled": sorted(self._compiled),
+            "compile_count": self.compile_count,
+            "num_feature": self.num_feature,
+            "num_trees": self.gbtree.num_trees,
+            "objective": self.booster.param.objective,
+        }

@@ -1,0 +1,289 @@
+"""Stdlib-only HTTP front end for the serving stack.
+
+Endpoints (SERVING.md):
+
+- ``POST /predict`` — body is CSV rows (default) or libsvm rows
+  (``?format=libsvm`` or ``Content-Type: text/libsvm``); responds
+  ``{"predictions": [...], "model_version": v, "rows": n}``.
+  ``?output_margin=1`` returns raw margins.  A full batch queue maps to
+  HTTP 503 (the batcher's reject-with-backpressure contract).
+- ``GET /healthz`` — liveness + model version + queue depth + p50/p99.
+- ``GET /metrics`` — Prometheus text exposition (ServingMetrics).
+- ``POST /-/reload`` — force one reload poll (also happens on the
+  background poll timer); ``POST /-/rollback`` swaps the previous
+  version back in.
+
+``ThreadingHTTPServer`` gives one thread per connection; all of them
+funnel into the single MicroBatcher queue, which is where concurrency
+turns into coalesced device batches.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from xgboost_tpu.serving.batcher import MicroBatcher, QueueFull
+from xgboost_tpu.serving.registry import ModelRegistry
+
+
+def parse_csv_rows(text: str) -> np.ndarray:
+    """CSV rows -> (n, F) float32 (empty fields / 'nan' = missing)."""
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        rows.append([float(tok) if tok.strip() not in ("", "na", "nan")
+                     else np.nan for tok in line.split(",")])
+    if not rows:
+        return np.zeros((0, 0), np.float32)
+    width = max(len(r) for r in rows)
+    out = np.full((len(rows), width), np.nan, np.float32)
+    for i, r in enumerate(rows):
+        out[i, :len(r)] = r
+    return out
+
+
+def parse_libsvm_rows(text: str, num_feature: int) -> np.ndarray:
+    """libsvm rows -> (n, F) float32 with NaN for absent features.  A
+    leading label token (no ':') is tolerated and ignored — serving
+    inputs are features-only, but clients often replay training files."""
+    rows = []
+    for line in text.splitlines():
+        toks = line.split("#", 1)[0].split()
+        if not toks:
+            continue
+        feats = {}
+        for j, tok in enumerate(toks):
+            if ":" not in tok:
+                if j == 0:
+                    continue  # label column
+                raise ValueError(f"bad libsvm token {tok!r}")
+            idx, _, val = tok.partition(":")
+            feats[int(idx)] = float(val)
+        rows.append(feats)
+    out = np.full((len(rows), num_feature), np.nan, np.float32)
+    for i, feats in enumerate(rows):
+        for idx, val in feats.items():
+            if 0 <= idx < num_feature:
+                out[i, idx] = val
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server instance carries registry/batcher/metrics (see
+    # PredictServer below)
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs through quiet
+        if not self.server.quiet:
+            super().log_message(fmt, *args)
+
+    # --------------------------------------------------------------- util
+    def _send(self, code: int, body: bytes,
+              content_type: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj).encode())
+
+    # ---------------------------------------------------------------- GET
+    def do_GET(self):
+        url = urlparse(self.path)
+        if url.path == "/healthz":
+            reg: ModelRegistry = self.server.registry
+            m = self.server.metrics
+            q = m.quantiles((0.5, 0.99))
+            self._send_json(200, {
+                "status": "ok",
+                "model_version": reg.version,
+                "queue_rows": self.server.batcher.queued_rows,
+                "buckets_compiled": reg.engine.num_compiled,
+                "latency_p50_ms": round(q[0.5] * 1e3, 3),
+                "latency_p99_ms": round(q[0.99] * 1e3, 3),
+            })
+            return
+        if url.path == "/metrics":
+            self._send(200, self.server.metrics.render().encode(),
+                       "text/plain; version=0.0.4")
+            return
+        self._send_json(404, {"error": f"no route {url.path}"})
+
+    # --------------------------------------------------------------- POST
+    def do_POST(self):
+        url = urlparse(self.path)
+        # ALWAYS drain the body: under HTTP/1.1 keep-alive, unread body
+        # bytes would be parsed as the next request line on the reused
+        # connection (e.g. a POST /-/reload with a JSON body).  Bodies
+        # we cannot drain deterministically (chunked encoding, bad or
+        # negative Content-Length) get an error AND a closed connection
+        # — never a blocking read(-1), never poisoned pipelining.
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        if "chunked" in te:
+            self.close_connection = True
+            self._send_json(411, {"error": "chunked bodies not "
+                                           "supported; send Content-Length"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if length < 0:
+            self.close_connection = True
+            self._send_json(400, {"error": "bad Content-Length"})
+            return
+        body = self.rfile.read(length).decode("utf-8", "replace")
+        if url.path == "/predict":
+            self._predict(url, body)
+            return
+        if url.path == "/-/reload":
+            reloaded = self.server.registry.check_reload()
+            self._send_json(200, {"reloaded": reloaded,
+                                  "model_version":
+                                      self.server.registry.version})
+            return
+        if url.path == "/-/rollback":
+            ok = self.server.registry.rollback()
+            self._send_json(200 if ok else 409,
+                            {"rolled_back": ok,
+                             "model_version": self.server.registry.version})
+            return
+        self._send_json(404, {"error": f"no route {url.path}"})
+
+    def _predict(self, url, body: str) -> None:
+        try:
+            qs = parse_qs(url.query)
+            fmt = qs.get("format", [None])[0]
+            if fmt is None:
+                ctype = (self.headers.get("Content-Type") or "").lower()
+                fmt = "libsvm" if "libsvm" in ctype else "csv"
+            output_margin = qs.get("output_margin", ["0"])[0] in ("1", "true")
+            reg: ModelRegistry = self.server.registry
+            if fmt == "libsvm":
+                X = parse_libsvm_rows(body, reg.engine.num_feature)
+            elif fmt == "csv":
+                X = parse_csv_rows(body)
+            else:
+                self._send_json(400, {"error": f"unknown format {fmt!r}"})
+                return
+            if X.shape[0] == 0:
+                self._send_json(400, {"error": "no rows in request body"})
+                return
+        except Exception as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            preds = self.server.batcher.submit(X, output_margin=output_margin)
+        except QueueFull as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        except ValueError as e:
+            # deterministic client-input errors surfaced by the engine
+            # (e.g. more columns than model features) are 400s, not
+            # server faults — keeps 5xx alerting honest
+            self._send_json(400, {"error": str(e)})
+            return
+        except Exception as e:
+            self._send_json(500, {"error": str(e)})
+            return
+        # the version that actually PRODUCED these predictions (tagged
+        # by the registry; reg.version may have moved during a reload)
+        version = getattr(preds, "model_version", reg.version)
+        self._send_json(200, {"predictions": np.asarray(preds).tolist(),
+                              "model_version": version,
+                              "rows": int(X.shape[0])})
+
+
+class PredictServer:
+    """Bundles registry + batcher + metrics behind ThreadingHTTPServer.
+
+    ``port=0`` binds an ephemeral port (tests); the bound port is on
+    ``self.port``.  Use :meth:`start` for a background thread or
+    :meth:`serve_forever` to block.
+    """
+
+    def __init__(self, registry: ModelRegistry, batcher: MicroBatcher,
+                 metrics, host: str = "127.0.0.1", port: int = 8080,
+                 quiet: bool = True):
+        self.registry = registry
+        self.batcher = batcher
+        self.metrics = metrics
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.registry = registry
+        self._httpd.batcher = batcher
+        self._httpd.metrics = metrics
+        self._httpd.quiet = quiet
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PredictServer":
+        self.registry.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="xgbtpu-http")
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.registry.start()
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        self.registry.stop()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+
+def run_server(model_path: str, host: str = "127.0.0.1", port: int = 8080,
+               min_bucket: int = 8, max_bucket: int = 8192,
+               max_batch_rows: int = 1024, max_wait_ms: float = 2.0,
+               max_queue_rows: int = 8192, poll_sec: float = 1.0,
+               keep_versions: int = 2, warmup: bool = True,
+               quiet: bool = False, block: bool = True
+               ) -> Optional[PredictServer]:
+    """Build the full serving stack for one model file and run it.
+
+    With ``block=False`` the server runs on a background thread and the
+    :class:`PredictServer` is returned (tests, embedding)."""
+    import sys
+
+    from xgboost_tpu.profiling import ServingMetrics
+    metrics = ServingMetrics()
+    registry = ModelRegistry(model_path, keep_versions=keep_versions,
+                             warmup=warmup, poll_sec=poll_sec,
+                             metrics=metrics, min_bucket=min_bucket,
+                             max_bucket=max_bucket)
+    batcher = MicroBatcher(registry.predict, max_batch_rows=max_batch_rows,
+                           max_wait_ms=max_wait_ms,
+                           max_queue_rows=max_queue_rows, metrics=metrics)
+    server = PredictServer(registry, batcher, metrics, host=host, port=port,
+                           quiet=quiet)
+    if not quiet:
+        eng = registry.engine
+        print(f"[serving] model {model_path} (v{registry.version}, "
+              f"{eng.gbtree.num_trees} trees, {eng.num_feature} features) "
+              f"on http://{server.host}:{server.port} — buckets "
+              f"{eng.buckets}", file=sys.stderr)
+    if block:
+        server.serve_forever()
+        return None
+    return server.start()

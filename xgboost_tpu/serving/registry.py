@@ -1,0 +1,212 @@
+"""Model registry: watch a model path, hot-reload atomically, keep
+previous versions for instant rollback.
+
+Reload protocol (the "load + warm OFF the serving path, then swap a
+reference" design, SERVING.md):
+
+1. a poll notices the file changed (mtime/size fast path, content hash
+   to confirm — a rewrite with identical bytes is NOT a reload);
+2. the new model is loaded into a FRESH :class:`PredictEngine` and
+   warmed (all buckets compiled + executed) while the old engine keeps
+   serving;
+3. one reference assignment swaps the engines.  In-flight batches hold
+   the old engine reference and finish on it — no request ever sees a
+   half-loaded model;
+4. the old (version, engine) pair is pushed onto a bounded rollback
+   ring (``keep_versions`` deep); :meth:`rollback` swaps it straight
+   back without touching disk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import threading
+from collections import deque
+from typing import Optional, Tuple
+
+import numpy as np
+
+from xgboost_tpu.serving.engine import PredictEngine
+
+
+class VersionedArray(np.ndarray):
+    """ndarray tagged with the model version that PRODUCED it.  The tag
+    survives slicing (the batcher scatters one batch's output across
+    callers), so a response's ``model_version`` names the model that
+    actually ran — not whatever was current when the request arrived,
+    which can differ across a hot-reload."""
+
+    model_version: int = 0
+
+    def __array_finalize__(self, obj):
+        self.model_version = getattr(obj, "model_version", 0)
+
+    @classmethod
+    def tag(cls, arr: np.ndarray, version: int) -> "VersionedArray":
+        out = np.asarray(arr).view(cls)
+        out.model_version = version
+        return out
+
+
+class ModelRegistry:
+    """Owns the live engine + its predecessors for one model path."""
+
+    def __init__(self, path: str, keep_versions: int = 2,
+                 warmup: bool = True, poll_sec: float = 1.0,
+                 metrics=None, **engine_kwargs):
+        self.path = path
+        self.keep_versions = int(keep_versions)
+        self.warmup = bool(warmup)
+        self.poll_sec = float(poll_sec)
+        self.metrics = metrics
+        self.engine_kwargs = engine_kwargs
+        self.version = 0
+        self._engine: Optional[PredictEngine] = None
+        self._previous: deque = deque(maxlen=max(0, self.keep_versions))
+        self._fp: Optional[Tuple] = None
+        self._reload_lock = threading.Lock()   # one reload at a time
+        self._swap_lock = threading.Lock()     # guards engine/version swap
+        self._stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+        self._load_initial()
+
+    # ------------------------------------------------------------- loading
+    def _fingerprint(self, fast: bool = False) -> Tuple:
+        """(mtime_ns, size, sha256).  With ``fast=True`` and an
+        unchanged stat, the stored hash is reused — the per-poll fast
+        path never reads the file; the hash is only recomputed to
+        confirm an apparent change (a touch with identical bytes must
+        NOT trigger a reload)."""
+        st = os.stat(self.path)
+        if (fast and self._fp is not None
+                and (st.st_mtime_ns, st.st_size) == self._fp[:2]):
+            return self._fp
+        h = hashlib.sha256()
+        with open(self.path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return (st.st_mtime_ns, st.st_size, h.hexdigest())
+
+    def _build_engine(self) -> Tuple[PredictEngine, Tuple]:
+        fp = self._fingerprint()
+        engine = PredictEngine(self.path, metrics=self.metrics,
+                               **self.engine_kwargs)
+        if self.warmup:
+            engine.warmup()
+        return engine, fp
+
+    def _load_initial(self) -> None:
+        engine, fp = self._build_engine()
+        with self._swap_lock:
+            self._engine, self._fp = engine, fp
+            self.version = 1
+        if self.metrics is not None:
+            self.metrics.model_version.set(self.version)
+
+    # --------------------------------------------------------------- state
+    @property
+    def engine(self) -> PredictEngine:
+        """The live engine.  Reference reads are atomic; callers that
+        need (version, engine) consistent use :meth:`current`."""
+        return self._engine
+
+    def current(self) -> Tuple[int, PredictEngine]:
+        with self._swap_lock:
+            return self.version, self._engine
+
+    def predict(self, X, output_margin: bool = False):
+        """Predict on whatever model is current when the call starts
+        (the batcher's per-batch engine resolution); the result is
+        tagged with the version that ran (:class:`VersionedArray`)."""
+        version, engine = self.current()
+        out = engine.predict(X, output_margin=output_margin)
+        return VersionedArray.tag(out, version)
+
+    # -------------------------------------------------------------- reload
+    def check_reload(self) -> bool:
+        """Poll once: reload + swap if the file content changed.
+        Returns True when a new model went live.  A failed load (e.g. a
+        half-written file racing the poll) keeps the old model serving
+        and retries on the next poll."""
+        with self._reload_lock:
+            try:
+                fp = self._fingerprint(fast=True)
+            except OSError:
+                return False  # file mid-replace; next poll sees the result
+            if fp == self._fp:
+                return False
+            if self._fp is not None and fp[2] == self._fp[2]:
+                self._fp = fp  # touched but byte-identical: not a reload
+                return False
+            try:
+                engine, fp = self._build_engine()
+            except Exception as e:
+                if self.metrics is not None:
+                    self.metrics.reload_errors.inc()
+                print(f"[serving] reload failed, keeping v{self.version}: "
+                      f"{e}", file=sys.stderr)
+                return False
+            with self._swap_lock:
+                self._previous.append((self.version, self._engine))
+                self._engine, self._fp = engine, fp
+                self.version += 1
+                v = self.version
+            if self.metrics is not None:
+                self.metrics.reloads.inc()
+                self.metrics.model_version.set(v)
+            return True
+
+    def rollback(self) -> bool:
+        """Swap the most recent previous version back in (no disk I/O —
+        its engine is still warm).  Returns False when the ring is
+        empty.
+
+        Deliberately NOT serialized behind ``_reload_lock``: rollback is
+        the emergency path and must stay instant even while a (slow)
+        reload build holds that lock — it only mutates in-memory state,
+        so the swap lock suffices.  A reload that completes after the
+        rollback still swaps its model in (it was requested by a newer
+        file change); roll back again to undo it."""
+        with self._swap_lock:
+            if not self._previous:
+                return False
+            old_version, old_engine = self._previous.pop()
+            # the outgoing engine goes onto the ring in turn, so an
+            # accidental rollback is itself reversible (rollback twice
+            # toggles between the two newest versions)
+            self._previous.append((self.version, self._engine))
+            self._engine = old_engine
+            # _fp still holds the on-disk fingerprint, so the next
+            # poll will NOT re-load the model just rolled back from;
+            # the rollback sticks until the file actually changes
+            self.version += 1
+            v = self.version
+        if self.metrics is not None:
+            self.metrics.model_version.set(v)
+        print(f"[serving] rolled back to engine of v{old_version} "
+              f"(now v{v})", file=sys.stderr)
+        return True
+
+    # ---------------------------------------------------------------- poll
+    def start(self) -> None:
+        """Start the background poll thread (no-op when poll_sec <= 0)."""
+        if self.poll_sec <= 0 or self._poller is not None:
+            return
+        self._poller = threading.Thread(target=self._poll_loop, daemon=True,
+                                        name="xgbtpu-model-poll")
+        self._poller.start()
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_sec):
+            try:
+                self.check_reload()
+            except Exception as e:  # the poller must survive anything
+                print(f"[serving] poll error: {e}", file=sys.stderr)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(self.poll_sec + 5.0)
+            self._poller = None

@@ -115,6 +115,18 @@ class XGBModel(BaseEstimator):
         return DMatrix(X, label=y, weight=sample_weight,
                        missing=self.missing)
 
+    def _predict_data(self, X):
+        """Prediction input: Booster.predict auto-wraps plain arrays
+        (the single wrapping implementation, shared with the serving
+        engine); only a non-NaN missing marker or a sparse input still
+        needs the explicit DMatrix wrap here."""
+        if hasattr(X, "num_row"):  # already a DMatrix flavor
+            return X
+        if isinstance(X, np.ndarray) and (
+                self.missing is None or np.isnan(self.missing)):
+            return X
+        return self._dmatrix(X)
+
     def _encode_labels(self, y):
         """Hook: (train labels, extra params, eval-label transform)."""
         return y, {}, lambda ey: ey
@@ -144,11 +156,12 @@ class XGBModel(BaseEstimator):
         return self
 
     def predict(self, X):
-        return self.get_booster().predict(self._dmatrix(X))
+        return self.get_booster().predict(self._predict_data(X))
 
     def apply(self, X):
         """Leaf index per (row, tree) (Booster.predict pred_leaf)."""
-        return self.get_booster().predict(self._dmatrix(X), pred_leaf=True)
+        return self.get_booster().predict(self._predict_data(X),
+                                          pred_leaf=True)
 
     @property
     def feature_importances_(self) -> np.ndarray:
@@ -196,7 +209,7 @@ class XGBClassifier(XGBModel, ClassifierMixin):
         return self._le.inverse_transform(np.argmax(probs, axis=1))
 
     def predict_proba(self, X):
-        raw = self.get_booster().predict(self._dmatrix(X))
+        raw = self.get_booster().predict(self._predict_data(X))
         if raw.ndim > 1:  # multi:softprob
             return raw
         return np.vstack([1.0 - raw, raw]).T
