@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Chaos loop: repeated kill-at-random-fault-point train/resume driver.
+
+Each run arms a RANDOM failure combination against the real training
+CLI — a worker death at a random (version, seqno) collective coordinate
+(``mock=`` / parallel/mock.py) plus, half the time, a torn-write or
+bit-flip fault on a random checkpoint-ring member at a random byte
+offset (``reliability/faults.py``) — then lets the keepalive restart
+recover through the checkpoint ring and asserts the finished model is
+BIT-identical to an uninterrupted reference run.
+
+Emits ``CHAOS.json``::
+
+    {"runs": N, "recoveries": n, "bit_identical": n, "mismatches": 0,
+     "deaths": total_kills, "corruptions_armed": n,
+     "ring_fallbacks": n, "quarantines": n, "integrity_failures": n}
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_loop.py --runs 10 --seed 0
+
+Also runs as a slow-marked test
+(tests/test_reliability.py::test_chaos_loop_driver).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _write_libsvm(path: str, n: int = 300, f: int = 5, seed: int = 0) -> None:
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (X[:, 0] > 0.5).astype(int)
+    with open(path, "w") as fh:
+        for i in range(n):
+            feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(f))
+            fh.write(f"{y[i]} {feats}\n")
+
+
+def _state(path: str):
+    import xgboost_tpu as xgb
+    return xgb.Booster(model_file=path).gbtree.get_state()
+
+
+def _states_equal(a, b) -> bool:
+    if set(a) != set(b):
+        return False
+    return all(np.array_equal(a[k], b[k]) for k in a)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="CHAOS.json")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    args = ap.parse_args(argv)
+
+    from xgboost_tpu.cli import main as cli_main
+    from xgboost_tpu.profiling import reliability_metrics
+    from xgboost_tpu.reliability import faults
+
+    work = args.workdir or tempfile.mkdtemp(prefix="xgbtpu_chaos_")
+    os.makedirs(work, exist_ok=True)
+    data = os.path.join(work, "train.libsvm")
+    _write_libsvm(data, seed=args.seed)
+    common = [f"data={data}", "task=train", f"num_round={args.rounds}",
+              "silent=2", "objective=binary:logistic", "max_depth=3",
+              "eta=0.5", "max_bin=16"]
+
+    # the uninterrupted reference (checkpointing ON so the code path is
+    # identical up to the injected failures)
+    ref_model = os.path.join(work, "ref.model")
+    rc = cli_main(common + [f"model_out={ref_model}",
+                            f"checkpoint_dir={os.path.join(work, 'ck_ref')}"])
+    if rc != 0:
+        print(f"reference run failed (rc={rc})", file=sys.stderr)
+        return 1
+    ref = _state(ref_model)
+
+    rng = np.random.RandomState(args.seed)
+    rm = reliability_metrics()
+    base = {"ring_fallbacks": rm.ring_fallbacks.value,
+            "quarantines": rm.quarantines.value,
+            "integrity_failures": rm.integrity_failures.value}
+    report = {"runs": args.runs, "recoveries": 0, "bit_identical": 0,
+              "mismatches": 0, "deaths": 0, "corruptions_armed": 0,
+              "run_log": []}
+
+    for run in range(args.runs):
+        ck = os.path.join(work, f"ck_{run:03d}")
+        out = os.path.join(work, f"m_{run:03d}.model")
+        # 1-2 deaths at random round boundaries (distinct versions so
+        # the second coordinate is reachable after the first restart)
+        versions = sorted(rng.choice(
+            np.arange(1, args.rounds), size=int(rng.randint(1, 3)),
+            replace=False))
+        mock = ";".join(f"{int(v)},0,{i}" for i, v in enumerate(versions))
+        entry = {"run": run, "mock": mock, "fault": None}
+        faults.clear_faults()
+        if rng.rand() < 0.5:
+            # corrupt the ring member the restart will want: the one
+            # written just before the (first) death
+            kind = "torn_write" if rng.rand() < 0.5 else "bit_flip"
+            at = int(rng.randint(16, 1000))
+            target = f"ckpt-{int(versions[0]):06d}"
+            faults.inject(kind, at, path_sub=target)
+            entry["fault"] = f"{kind}={at}@{target}"
+            report["corruptions_armed"] += 1
+        try:
+            rc = cli_main(common + [f"model_out={out}",
+                                    f"checkpoint_dir={ck}",
+                                    f"mock={mock}", "keepalive=1"])
+        except BaseException as e:  # noqa: BLE001 — recorded in the report
+            entry["error"] = f"{type(e).__name__}: {e}"
+            rc = -1
+        finally:
+            faults.clear_faults()
+        report["deaths"] += len(versions)
+        if rc == 0:
+            report["recoveries"] += 1
+            got = _state(out)
+            if _states_equal(ref, got):
+                report["bit_identical"] += 1
+                entry["result"] = "bit_identical"
+            else:
+                report["mismatches"] += 1
+                entry["result"] = "MISMATCH"
+        else:
+            report["mismatches"] += 1
+            entry["result"] = f"rc={rc}"
+        report["run_log"].append(entry)
+        print(f"[chaos] run {run}: mock={mock} fault={entry['fault']} "
+              f"-> {entry['result']}", file=sys.stderr)
+
+    report["ring_fallbacks"] = rm.ring_fallbacks.value - base["ring_fallbacks"]
+    report["quarantines"] = rm.quarantines.value - base["quarantines"]
+    report["integrity_failures"] = (rm.integrity_failures.value
+                                    - base["integrity_failures"])
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"[chaos] {report['bit_identical']}/{args.runs} bit-identical, "
+          f"{report['ring_fallbacks']:.0f} ring fallbacks, "
+          f"{report['quarantines']:.0f} quarantines -> {args.out}",
+          file=sys.stderr)
+    return 0 if report["mismatches"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,10 +11,13 @@ file of ``name = value`` pairs plus command-line overrides, dispatching
 Fault tolerance: where the reference wraps the round loop in rabit
 checkpoints (``xgboost_main.cpp:175-229``, two versions per round), this
 driver checkpoints the model to ``checkpoint_dir`` after every round and
-resumes from the newest checkpoint on restart (SURVEY.md §5.3 TPU
-mapping: per-round model checkpoint + restartable loop keyed by round
-version; collectives themselves are not elastically recoverable
-mid-step under XLA).
+resumes from the newest VERIFIABLE checkpoint on restart (SURVEY.md
+§5.3 TPU mapping: per-round model checkpoint + restartable loop keyed
+by round version; collectives themselves are not elastically
+recoverable mid-step under XLA).  Checkpoint writes are atomic +
+CRC-footered, a corrupt newest member is quarantined and the older
+ring replica used instead (RELIABILITY.md), and ``faults=`` arms I/O
+chaos injection the way ``mock=`` arms collective-seam deaths.
 """
 
 from __future__ import annotations
@@ -71,6 +74,7 @@ class BoostLearnTask:
         self.save_base64 = 0  # text-safe model files (reference bs64 mode)
         self.shard_load = 1  # per-rank split loading in distributed mode
         self.mock_spec: List[Tuple[int, int, int]] = []  # fault injection
+        self.faults_spec: Optional[str] = None  # I/O chaos (faults=...)
         self.keepalive = 0  # restart-on-WorkerFailure (rabit_demo keepalive)
         self.rank = 0  # process index under multi-host launch
         self._distributed = False
@@ -132,6 +136,11 @@ class BoostLearnTask:
                 self.mock_spec.append(tuple(nums))
         elif name == "keepalive":
             self.keepalive = int(val)
+        elif name == "faults":
+            # I/O + serving chaos injection (reliability/faults.py):
+            # "kind[=arg][@path][#times];..." — the file-system sibling
+            # of the collective-seam mock= parameter
+            self.faults_spec = val
         elif name in self.serve_params:
             self.serve_params[name] = type(SERVE_PARAMS[name][0])(val)
         else:
@@ -163,6 +172,9 @@ class BoostLearnTask:
         if self.model_out == "stdout" or self.name_pred == "stdout":
             self.set_param("silent", "1")
             self.save_period = 0
+        if self.faults_spec:
+            from xgboost_tpu.reliability import faults
+            faults.install_spec(self.faults_spec)
 
         if (self.checkpoint_dir and self.task == "train"
                 and not os.environ.get("XGBTPU_NO_JITCACHE")):
@@ -463,6 +475,8 @@ class BoostLearnTask:
             poll_sec=sp["serve_poll_sec"],
             keep_versions=sp["serve_keep_versions"],
             warmup=bool(sp["serve_warmup"]),
+            drain_sec=sp["serve_drain_sec"],
+            max_body_mb=sp["serve_max_body_mb"],
             quiet=self.silent != 0, block=True)
         return 0
 
@@ -494,13 +508,12 @@ def _ckpt_path(ckpt_dir: str, version: int) -> str:
 
 
 def _save_checkpoint(ckpt_dir: str, bst, version: int) -> None:
-    """Atomic per-round checkpoint (the rabit::CheckPoint analog — the
-    model is tiny, so a full save per round is cheap; SURVEY.md §5.3)."""
+    """Per-round checkpoint (the rabit::CheckPoint analog — the model
+    is tiny, so a full save per round is cheap; SURVEY.md §5.3).
+    ``save_model`` itself is atomic + CRC-footered (reliability/
+    integrity.py), so a crash mid-save can never tear a ring member."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    path = _ckpt_path(ckpt_dir, version)
-    tmp = path + ".tmp"
-    bst.save_model(tmp)
-    os.replace(tmp, path)
+    bst.save_model(_ckpt_path(ckpt_dir, version))
     # keep only the two most recent checkpoints (ring of replicas analog)
     kept = sorted(f for f in os.listdir(ckpt_dir)
                   if re.fullmatch(r"ckpt-\d{6}\.model", f))
@@ -509,18 +522,56 @@ def _save_checkpoint(ckpt_dir: str, bst, version: int) -> None:
 
 
 def _load_checkpoint(ckpt_dir: str, bst, params: dict):
-    """Resume from the newest checkpoint (rabit::LoadCheckPoint analog,
-    version 0 when none exists — reference xgboost_main.cpp:176-183)."""
+    """Resume from the newest VERIFIABLE checkpoint (rabit's two-replica
+    ring made real): when the newest member fails verification — torn
+    write, bit flip, unparseable — it is quarantined as ``*.corrupt``
+    and the older replica is used instead; version 0 when nothing
+    loads (reference xgboost_main.cpp:176-183)."""
     if not os.path.isdir(ckpt_dir):
         return bst, 0
     found = sorted(f for f in os.listdir(ckpt_dir)
                    if re.fullmatch(r"ckpt-\d{6}\.model", f))
-    if not found:
-        return bst, 0
-    version = int(found[-1][5:11])
-    bst.load_model(os.path.join(ckpt_dir, found[-1]))
-    bst.set_param(params)
-    return bst, version
+    for name in reversed(found):
+        path = os.path.join(ckpt_dir, name)
+        # ONE read, verified, probed on a THROWAWAY booster, and only
+        # then loaded into the real one from the SAME buffer: a failed
+        # load can leave its target half-mutated (param/objective
+        # adopted from a corrupt header before the state arrays
+        # raised), and the real booster must keep the caller's config
+        # when the whole ring is bad.  Re-reading between probe and
+        # load would let the file change under us after verification.
+        try:
+            from xgboost_tpu.learner import Booster
+            from xgboost_tpu.reliability.integrity import (
+                read_file, verify_model_bytes)
+            payload = verify_model_bytes(read_file(path), name=path)
+            Booster().load_raw(payload, name=path)
+        except OSError as e:
+            # transient I/O (EIO, EMFILE, permission blip): the bytes
+            # may be fine — do NOT quarantine; fall back for THIS
+            # restart and let the next one retry the member
+            print(f"[ckpt] {name} unreadable ({e}); trying the older "
+                  "ring member (file left in place)", file=sys.stderr)
+            continue
+        except Exception as e:
+            from xgboost_tpu.profiling import reliability_metrics
+            from xgboost_tpu.reliability.integrity import quarantine
+            try:
+                qpath = quarantine(path)
+                q_msg = f"quarantined as {os.path.basename(qpath)}"
+            except OSError as qe:
+                # a failed rename must not abort the restart the ring
+                # exists to survive
+                q_msg = f"quarantine failed ({qe}); left in place"
+            reliability_metrics().ring_fallbacks.inc()
+            print(f"[ckpt] {name} failed verification ({e}); {q_msg}, "
+                  "falling back to the older ring member",
+                  file=sys.stderr)
+            continue
+        bst.load_raw(payload, name=path)  # the verified buffer itself
+        bst.set_param(params)
+        return bst, int(name[5:11])
+    return bst, 0
 
 
 def _broadcast_checkpoint(bst, start_round: int, rank: int, params: dict):

@@ -247,6 +247,11 @@ SERVE_PARAMS: Dict[str, Tuple[Any, str]] = {
                                "instant rollback"),
     "serve_warmup": (1, "pre-compile every row bucket at startup "
                         "(recompile-free steady state)"),
+    "serve_drain_sec": (30.0, "SIGTERM drain grace: max seconds to wait "
+                              "for in-flight requests before exit"),
+    "serve_max_body_mb": (64.0, "largest accepted request body; bigger "
+                                "Content-Length is rejected with 413 "
+                                "before buffering"),
 }
 
 

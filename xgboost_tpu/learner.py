@@ -1205,7 +1205,14 @@ class Booster:
     def save_model(self, path: str, save_base64: bool = False):
         """Save the model; ``save_base64`` writes the text-safe encoding
         (the reference's ``bs64`` mode, learner-inl.hpp:240-252, which
-        survives text-only channels)."""
+        survives text-only channels).
+
+        File writes are crash-safe: the payload (plus its CRC32
+        integrity footer, reliability/integrity.py) goes through
+        ``atomic_write``, so a watcher of ``path`` — the serving
+        ModelRegistry, the checkpoint ring — can never observe a torn
+        file.  ``stdout`` streams the bare payload (no footer: the
+        reader of a pipe already owns the transport)."""
         assert self.gbtree is not None, "nothing to save"
         header = {
             "magic": _MAGIC,
@@ -1217,31 +1224,32 @@ class Booster:
             "best_iteration": self.best_iteration,
         }
         state = self.gbtree.get_state()
+        import io
+        buf = io.BytesIO()
+        np.savez(buf, header=np.frombuffer(
+            json.dumps(header).encode(), dtype=np.uint8), **state)
+        payload = buf.getvalue()
         if save_base64 or path == "stdout":
             # stdout is always base64, like the reference
             # (learner-inl.hpp:240-243)
             import base64
-            import io
-            import sys
-            buf = io.BytesIO()
-            np.savez(buf, header=np.frombuffer(
-                json.dumps(header).encode(), dtype=np.uint8), **state)
-            payload = b"bs64\t" + base64.b64encode(buf.getvalue()) + b"\n"
+            payload = b"bs64\t" + base64.b64encode(payload) + b"\n"
             if path == "stdout":
+                import sys
                 sys.stdout.buffer.write(payload)
                 sys.stdout.buffer.flush()
-            else:
-                with open(path, "wb") as f:
-                    f.write(payload)
-            return
-        with open(path, "wb") as f:
-            np.savez(f, header=np.frombuffer(
-                json.dumps(header).encode(), dtype=np.uint8), **state)
+                return
+        from xgboost_tpu.reliability.integrity import (add_footer,
+                                                       atomic_write)
+        atomic_write(path, add_footer(payload))
 
     def load_model(self, path: str):
-        with open(path, "rb") as f:
-            raw = f.read()
-        self.load_raw(raw, name=path)
+        from xgboost_tpu.reliability.integrity import (read_file,
+                                                       verify_model_bytes)
+        raw = read_file(path)
+        # strips + checks the CRC footer; raises ModelIntegrityError on
+        # torn/bit-flipped files, warns once on footer-less legacy files
+        self.load_raw(verify_model_bytes(raw, name=path), name=path)
 
     def load_raw(self, raw: bytes, name: str = "<buffer>"):
         """Load a model from an in-memory buffer (reference
@@ -1256,7 +1264,14 @@ class Booster:
             return
         if head == b"bs64\t":
             import base64
-            dec = base64.b64decode(b"".join(raw[5:].split()))
+            try:
+                dec = base64.b64decode(b"".join(raw[5:].split()),
+                                       validate=True)
+            except Exception as e:
+                from xgboost_tpu.reliability.integrity import \
+                    ModelIntegrityError
+                raise ModelIntegrityError(
+                    f"{name}: torn/invalid bs64 payload: {e}")
             if not dec.startswith(b"PK"):  # not our npz: reference stream
                 self._load_reference(dec)
                 return
@@ -1264,10 +1279,17 @@ class Booster:
         self._load_np(io.BytesIO(raw), name)
 
     def _load_np(self, src, path):
+        from xgboost_tpu.reliability.integrity import ModelIntegrityError
         try:
             z = np.load(src, allow_pickle=False)
         except Exception as e:
-            raise ValueError(f"{path} is not an xgboost_tpu model file: {e}")
+            # unparseable npz: for a footer-less file this is the only
+            # torn-write signal there is — type it so recovery paths
+            # (checkpoint-ring fallback, registry poisoning) can react
+            from xgboost_tpu.profiling import reliability_metrics
+            reliability_metrics().integrity_failures.inc()
+            raise ModelIntegrityError(
+                f"{path} is not an xgboost_tpu model file: {e}")
         with z:
             header = json.loads(bytes(z["header"]).decode())
             assert header.get("magic") == _MAGIC, "not an xgboost_tpu model"

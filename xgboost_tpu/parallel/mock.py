@@ -16,6 +16,12 @@ mock semantics.
 Deterministic recovery holds because per-iteration seeding is derived by
 ``fold_in(seed, iteration)`` (the reference forces seed_per_iteration in
 distributed mode for the same reason, learner-inl.hpp:275-277).
+
+This module owns the COLLECTIVE seam only; the same injection idea for
+the I/O and serving seams (torn writes, bit flips, ENOSPC, slow reads,
+reload failures) lives in ``xgboost_tpu.reliability.faults`` — the two
+compose in the chaos suite (kill a worker AND corrupt the checkpoint it
+must restart from; tests/test_reliability.py, tools/chaos_loop.py).
 """
 
 from __future__ import annotations

@@ -268,6 +268,60 @@ def _fmt(v: float) -> str:
     return f"{int(v)}" if float(v).is_integer() else repr(float(v))
 
 
+class ReliabilityMetrics:
+    """Process-wide failure-path accounting (RELIABILITY.md): how often
+    the crash-safety machinery actually engaged.  One instance per
+    process (:func:`reliability_metrics`), shared by the learner's
+    model I/O, the CLI checkpoint ring, and the serving stack; rendered
+    into the serving ``GET /metrics`` body alongside ServingMetrics."""
+
+    def __init__(self, prefix: str = "xgbtpu_reliability"):
+        p = prefix
+        self.integrity_failures = Counter(
+            f"{p}_integrity_failures_total",
+            "persisted files that failed CRC/footer verification")
+        self.ring_fallbacks = Counter(
+            f"{p}_ckpt_ring_fallbacks_total",
+            "checkpoint loads that fell back past a corrupt ring member")
+        self.quarantines = Counter(
+            f"{p}_quarantined_files_total",
+            "corrupt files moved aside as *.corrupt")
+        self.poisoned_reloads = Counter(
+            f"{p}_poisoned_reload_skips_total",
+            "reload polls skipped because the file content is known-bad")
+        self.shed_requests = Counter(
+            f"{p}_shed_requests_total",
+            "abandoned (caller timed out) requests shed before dispatch")
+        self.faults_injected = Counter(
+            f"{p}_faults_injected_total",
+            "chaos faults fired by the injection registry")
+        self.drain_seconds = Gauge(
+            f"{p}_drain_seconds",
+            "duration of the last HTTP drain (SIGTERM to stopped)")
+        self._all = (self.integrity_failures, self.ring_fallbacks,
+                     self.quarantines, self.poisoned_reloads,
+                     self.shed_requests, self.faults_injected,
+                     self.drain_seconds)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._all)
+
+
+_RELIABILITY: Optional[ReliabilityMetrics] = None
+_RELIABILITY_LOCK = threading.Lock()
+
+
+def reliability_metrics() -> ReliabilityMetrics:
+    """The process-wide ReliabilityMetrics singleton.  Counters are
+    cumulative for the process lifetime; tests read deltas."""
+    global _RELIABILITY
+    if _RELIABILITY is None:
+        with _RELIABILITY_LOCK:
+            if _RELIABILITY is None:
+                _RELIABILITY = ReliabilityMetrics()
+    return _RELIABILITY
+
+
 class ServingMetrics:
     """Metric registry for the serving subsystem (see SERVING.md for the
     full schema).  One instance is shared by engine + batcher + registry
@@ -343,4 +397,7 @@ class ServingMetrics:
             name = f"{self.prefix}_latency_{label}_seconds"
             parts.append(f"# HELP {name} {label} request latency\n"
                          f"# TYPE {name} gauge\n{name} {_fmt(v)}\n")
+        # the process-wide reliability counters ride along so one scrape
+        # covers both steady-state and failure-path behavior
+        parts.append(reliability_metrics().render())
         return "".join(parts)

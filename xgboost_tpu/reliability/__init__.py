@@ -1,0 +1,41 @@
+"""xgboost_tpu.reliability — crash-safe persistence + failure injection.
+
+Two modules wired through the whole stack (design in RELIABILITY.md):
+
+- :mod:`~xgboost_tpu.reliability.integrity` — ``atomic_write`` (tmp +
+  fsync + rename + dir fsync) and a CRC32 footer scheme so every
+  persisted model byte is verifiable; ``verify_model_bytes`` raises a
+  typed :class:`ModelIntegrityError` on torn or bit-flipped files.
+- :mod:`~xgboost_tpu.reliability.faults` — a process-wide fault
+  registry generalizing the collective-seam injector
+  (``parallel/mock.py``) to the I/O and serving seams: torn writes,
+  bit flips, ENOSPC, slow reads, reload failures — selectable via the
+  ``XGBTPU_FAULTS`` env var or the CLI ``faults=`` parameter, so chaos
+  tests drive the REAL code paths.
+
+Consumers: ``Learner.save_model``/``load_model`` (atomic + checksummed
+model files), the CLI checkpoint ring (fallback to the older replica +
+quarantine on corruption), and the serving ``ModelRegistry`` (verify
+before build, poisoned-fingerprint memory).
+"""
+
+from xgboost_tpu.reliability.faults import (InjectedFault, clear_faults,
+                                            inject, install_spec)
+from xgboost_tpu.reliability.integrity import (ModelIntegrityError,
+                                               add_footer, atomic_write,
+                                               has_footer, quarantine,
+                                               read_file, verify_model_bytes)
+
+__all__ = [
+    "ModelIntegrityError",
+    "atomic_write",
+    "add_footer",
+    "has_footer",
+    "verify_model_bytes",
+    "read_file",
+    "quarantine",
+    "InjectedFault",
+    "inject",
+    "clear_faults",
+    "install_spec",
+]

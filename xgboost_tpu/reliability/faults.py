@@ -1,0 +1,203 @@
+"""Process-wide fault registry for the I/O and serving seams.
+
+The reference proves recovery by *injecting* failures at exact
+coordinates (``AllreduceMock``, ``subtree/rabit/src/allreduce_mock.h``);
+``parallel/mock.py`` carries that injector for the collective seam.
+This module generalizes the idea to every other failure surface the
+system persists or serves through:
+
+========== =============================== ===========================
+kind        effect                          seam
+========== =============================== ===========================
+torn_write  truncate written bytes at N     ``integrity.atomic_write``
+bit_flip    flip one bit at byte N on write ``integrity.atomic_write``
+enospc      raise ``OSError(ENOSPC)``       ``integrity.atomic_write``
+slow_read   sleep N seconds before read     ``integrity.read_file``
+read_flip   flip one bit at byte N on read  ``integrity.read_file``
+reload      raise at the registry reload    ``ModelRegistry`` rebuild
+========== =============================== ===========================
+
+Faults are armed with :func:`inject` (tests), the CLI ``faults=``
+parameter, or the ``XGBTPU_FAULTS`` env var (subprocess chaos drivers,
+parsed once at import).  Spec grammar, semicolon-separated::
+
+    kind[=arg][@path_substring][*times]
+
+(``#times`` also works, but not inside CLI config files, where ``#``
+starts a comment), e.g.
+``XGBTPU_FAULTS="torn_write=128@ckpt-000003;slow_read=0.05*3"``
+truncates the write of the third checkpoint at byte 128 (once) and
+delays the next three reads by 50 ms.  Each armed fault fires
+``times`` times (default 1) and then disarms — the restarted run sails
+past it, exactly the reference mock's ``ntrial`` semantics.
+
+Because the seams are the REAL production code paths (the injector
+only mutates bytes or raises at them), a passing chaos suite certifies
+the actual recovery logic, not a test double.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+_WRITE_KINDS = ("torn_write", "bit_flip", "enospc")
+_READ_KINDS = ("slow_read", "read_flip")
+_POINT_KINDS = ("reload",)
+_KINDS = _WRITE_KINDS + _READ_KINDS + _POINT_KINDS
+
+
+class InjectedFault(OSError):
+    """An injected (not organic) failure; carries the fault kind."""
+
+    def __init__(self, kind: str, detail: str = ""):
+        super().__init__(f"[fault] injected {kind}"
+                         + (f": {detail}" if detail else ""))
+        self.kind = kind
+
+
+class _Fault:
+    __slots__ = ("kind", "arg", "path_sub", "remaining")
+
+    def __init__(self, kind: str, arg: Optional[float],
+                 path_sub: Optional[str], times: int):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; "
+                             f"known: {', '.join(_KINDS)}")
+        self.kind = kind
+        self.arg = arg
+        self.path_sub = path_sub
+        self.remaining = int(times)
+
+    def matches(self, path: Optional[str]) -> bool:
+        if self.remaining <= 0:
+            return False
+        if self.path_sub is None:
+            return True
+        return path is not None and self.path_sub in str(path)
+
+
+_registry: List[_Fault] = []
+_lock = threading.Lock()
+_fired: dict = {}
+
+
+def inject(kind: str, arg: Optional[float] = None,
+           path_sub: Optional[str] = None, times: int = 1) -> None:
+    """Arm one fault (see module docstring for kinds/args)."""
+    with _lock:
+        _registry.append(_Fault(kind, arg, path_sub, times))
+
+
+def clear_faults() -> None:
+    """Disarm everything (test teardown)."""
+    with _lock:
+        _registry.clear()
+
+
+def active() -> bool:
+    with _lock:
+        return any(f.remaining > 0 for f in _registry)
+
+
+def fired(kind: Optional[str] = None) -> int:
+    """How many faults have fired (optionally of one kind)."""
+    with _lock:
+        if kind is None:
+            return sum(_fired.values())
+        return _fired.get(kind, 0)
+
+
+def install_spec(spec: str) -> None:
+    """Parse and arm a ``kind[=arg][@path][*times];...`` spec string.
+    ``#times`` is accepted as an alias everywhere EXCEPT CLI config
+    files, whose parser strips ``#`` comments — use ``*times`` there."""
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        times = 1
+        for sep in ("*", "#"):
+            if sep in part:
+                part, _, t = part.rpartition(sep)
+                times = int(t)
+                break
+        path_sub = None
+        if "@" in part:
+            part, _, path_sub = part.partition("@")
+        arg: Optional[float] = None
+        if "=" in part:
+            part, _, a = part.partition("=")
+            arg = float(a)
+        inject(part.strip(), arg, path_sub or None, times)
+
+
+def _take(kinds, path: Optional[str]) -> List[_Fault]:
+    """Pop (decrement) every armed fault of the given kinds matching
+    ``path``, in arm order."""
+    out = []
+    with _lock:
+        for f in _registry:
+            if f.kind in kinds and f.matches(path):
+                f.remaining -= 1
+                _fired[f.kind] = _fired.get(f.kind, 0) + 1
+                out.append(f)
+    if out:
+        from xgboost_tpu.profiling import reliability_metrics
+        reliability_metrics().faults_injected.inc(len(out))
+    return out
+
+
+def _flip_bit(data: bytes, at: int) -> bytes:
+    if not data:
+        return data  # nothing to corrupt in an empty payload
+    at = min(max(int(at), 0), len(data) - 1)
+    b = bytearray(data)
+    b[at] ^= 0x40
+    return bytes(b)
+
+
+# ------------------------------------------------------------------ seams
+def mutate_write(path: str, data: bytes) -> bytes:
+    """Write seam: called by ``integrity.atomic_write`` with the bytes
+    about to be persisted.  May truncate (torn_write), corrupt
+    (bit_flip), or raise ``OSError(ENOSPC)``."""
+    for f in _take(_WRITE_KINDS, path):
+        if f.kind == "enospc":
+            import errno
+            raise OSError(errno.ENOSPC,
+                          f"[fault] injected ENOSPC writing {path}")
+        if f.kind == "torn_write":
+            n = int(f.arg if f.arg is not None else len(data) // 2)
+            data = data[:n]
+        elif f.kind == "bit_flip":
+            data = _flip_bit(data, f.arg if f.arg is not None
+                             else len(data) // 2)
+    return data
+
+
+def mutate_read(path: str, data: bytes) -> bytes:
+    """Read seam: called by ``integrity.read_file`` with the bytes just
+    read.  May delay (slow_read) or corrupt (read_flip)."""
+    for f in _take(_READ_KINDS, path):
+        if f.kind == "slow_read":
+            time.sleep(float(f.arg if f.arg is not None else 0.05))
+        elif f.kind == "read_flip":
+            data = _flip_bit(data, f.arg if f.arg is not None
+                             else len(data) // 2)
+    return data
+
+
+def check(point: str, path: Optional[str] = None) -> None:
+    """Named-point seam (currently ``reload``: the registry's engine
+    rebuild).  Raises :class:`InjectedFault` when armed."""
+    if _take((point,), path):
+        raise InjectedFault(point, str(path) if path else "")
+
+
+# subprocess chaos drivers arm faults via the environment; parse once at
+# import so any seam hit afterwards sees them
+if os.environ.get("XGBTPU_FAULTS"):
+    install_spec(os.environ["XGBTPU_FAULTS"])

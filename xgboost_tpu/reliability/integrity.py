@@ -1,0 +1,179 @@
+"""Crash-safe, integrity-checked file I/O for persisted models.
+
+Two guarantees (RELIABILITY.md):
+
+1. **No torn destination files.**  :func:`atomic_write` stages into a
+   same-directory temp file, flushes + fsyncs it, ``os.replace``-s over
+   the destination, and fsyncs the directory — a crash at ANY point
+   leaves either the complete old file or the complete new file, never
+   a prefix.
+2. **No silent corruption.**  Every model file written through
+   :func:`add_footer` carries a fixed-length ASCII CRC32 footer::
+
+       \\nXGTPUCRC1 <crc32:08x> <payload_len:016d>\\n
+
+   :func:`verify_model_bytes` strips and checks it, raising the typed
+   :class:`ModelIntegrityError` on torn or bit-flipped content.  The
+   footer is ASCII so the text-safe ``bs64`` model encoding stays
+   text-safe, and it is appended AFTER the payload so readers strip it
+   before parsing.  Files without a footer (pre-reliability saves,
+   reference-format models) load with a one-time warning — backward
+   compatible, just unverified.
+
+Both functions route through :mod:`~xgboost_tpu.reliability.faults`
+seams, so chaos tests corrupt/starve the REAL write and read paths.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import tempfile
+import zlib
+from typing import Union
+
+from xgboost_tpu.reliability import faults
+
+FOOTER_MAGIC = b"XGTPUCRC1"
+# \n + magic(9) + sp + crc(8 hex) + sp + len(16 dec) + \n
+FOOTER_LEN = 1 + 9 + 1 + 8 + 1 + 16 + 1
+_FOOTER_RE = re.compile(rb"\nXGTPUCRC1 ([0-9a-f]{8}) (\d{16})\n\Z")
+
+
+class ModelIntegrityError(ValueError):
+    """A persisted model failed verification (torn, truncated, or
+    bit-flipped).  Subclasses ``ValueError`` so pre-reliability callers
+    that caught generic parse errors keep working."""
+
+
+def make_footer(payload: bytes) -> bytes:
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return b"\n%s %08x %016d\n" % (FOOTER_MAGIC, crc, len(payload))
+
+
+def add_footer(payload: bytes) -> bytes:
+    """Payload + CRC32 footer (what every model writer persists)."""
+    return payload + make_footer(payload)
+
+
+def has_footer(raw: bytes) -> bool:
+    return _FOOTER_RE.search(raw) is not None
+
+
+_warned_unverified = set()
+
+
+def verify_model_bytes(raw: bytes, name: str = "<buffer>",
+                       warn: bool = True) -> bytes:
+    """Verify + strip the CRC footer, returning the payload.
+
+    Raises :class:`ModelIntegrityError` when the footer is present but
+    wrong (bit flip), truncated mid-footer (torn write), or the length
+    disagrees.  Footer-less files return unchanged with a one-time
+    warning per name — pre-reliability and reference-format models stay
+    loadable, just unverified."""
+    m = _FOOTER_RE.search(raw)
+    if m is None:
+        # a torn write can cut INSIDE the footer: payload bytes intact
+        # but the verification record mangled — that is corruption, not
+        # a legacy file.  Two tells: the full magic somewhere in the
+        # tail (cut after the magic), or the file ENDING with a proper
+        # prefix of the footer (cut inside the magic itself)
+        head = b"\n" + FOOTER_MAGIC + b" "
+        torn_prefix = any(raw.endswith(head[:k])
+                          for k in range(2, len(head)))
+        if torn_prefix or FOOTER_MAGIC in raw[-(FOOTER_LEN + 8):]:
+            _count_integrity_failure()
+            raise ModelIntegrityError(
+                f"{name}: truncated integrity footer (torn write)")
+        if warn and name not in _warned_unverified:
+            _warned_unverified.add(name)
+            print(f"[integrity] {name}: no integrity footer "
+                  "(pre-reliability or reference file); loading "
+                  "unverified", file=sys.stderr)
+        return raw
+    payload = raw[:-FOOTER_LEN]
+    want_crc, want_len = int(m.group(1), 16), int(m.group(2))
+    if len(payload) != want_len:
+        _count_integrity_failure()
+        raise ModelIntegrityError(
+            f"{name}: payload is {len(payload)} bytes, footer says "
+            f"{want_len} (torn write)")
+    got_crc = zlib.crc32(payload) & 0xFFFFFFFF
+    if got_crc != want_crc:
+        _count_integrity_failure()
+        raise ModelIntegrityError(
+            f"{name}: CRC32 mismatch (footer {want_crc:08x}, content "
+            f"{got_crc:08x}) — bit flip or partial overwrite")
+    return payload
+
+
+def _count_integrity_failure() -> None:
+    from xgboost_tpu.profiling import reliability_metrics
+    reliability_metrics().integrity_failures.inc()
+
+
+def atomic_write(path: Union[str, os.PathLike], data: bytes,
+                 durable: bool = True) -> None:
+    """Crash-safe whole-file write: tmp file in the destination
+    directory -> flush -> fsync -> ``os.replace`` -> directory fsync.
+    ``durable=False`` skips the fsyncs (scratch files, tests)."""
+    path = os.fspath(path)
+    data = faults.mutate_write(path, data)
+    d = os.path.dirname(os.path.abspath(path))
+    # mkstemp creates 0600; a plain open(path, "wb") would have given
+    # 0666&~umask (and overwriting keeps the old mode) — preserve that
+    # contract so a reader under another uid/gid doesn't lose access
+    try:
+        mode = os.stat(path).st_mode & 0o777
+    except OSError:
+        mask = os.umask(0)
+        os.umask(mask)
+        mode = 0o666 & ~mask
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fchmod(f.fileno(), mode)
+            if durable:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if durable:
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+
+def read_file(path: Union[str, os.PathLike]) -> bytes:
+    """Whole-file read through the fault seam (slow_read/read_flip)."""
+    path = os.fspath(path)
+    with open(path, "rb") as f:
+        raw = f.read()
+    return faults.mutate_read(path, raw)
+
+
+def quarantine(path: Union[str, os.PathLike]) -> str:
+    """Move a corrupt file aside as ``<path>.corrupt`` (numbered when
+    that exists) so retry loops stop re-reading it and a post-mortem
+    can inspect the bytes.  Returns the quarantine path."""
+    path = os.fspath(path)
+    dest = path + ".corrupt"
+    i = 1
+    while os.path.exists(dest):
+        dest = f"{path}.corrupt{i}"
+        i += 1
+    os.replace(path, dest)
+    from xgboost_tpu.profiling import reliability_metrics
+    reliability_metrics().quarantines.inc()
+    return dest

@@ -5,8 +5,11 @@ shape-bucketed cache of AOT-compiled predict executables over one
 loaded model; :class:`MicroBatcher` coalesces concurrent requests into
 single device calls with bounded-queue backpressure;
 :class:`ModelRegistry` hot-reloads a watched model path atomically with
-rollback; :class:`PredictServer` is the stdlib HTTP front end with
-``/predict``, ``/healthz`` and Prometheus ``/metrics``.
+rollback, CRC verification before build, and poisoned-fingerprint
+memory for corrupt files (RELIABILITY.md); :class:`PredictServer` is
+the stdlib HTTP front end with ``/predict``, ``/healthz`` (degraded /
+drain states) and Prometheus ``/metrics``, draining gracefully on
+SIGTERM.
 
 Quickstart::
 

@@ -39,8 +39,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                max_wait_ms=args.max_wait_ms,
                max_queue_rows=args.queue_rows, poll_sec=args.poll_sec,
                keep_versions=args.keep_versions,
-               warmup=bool(args.warmup), quiet=args.quiet,
-               block=True)
+               warmup=bool(args.warmup), drain_sec=args.drain_sec,
+               max_body_mb=args.max_body_mb,
+               quiet=args.quiet, block=True)
     return 0
 
 

@@ -11,6 +11,11 @@ Backpressure is explicit: the queue is bounded in ROWS (the unit that
 costs device time/memory), and a submit that would exceed it raises
 :class:`QueueFull` immediately instead of growing memory without bound
 — the HTTP front end maps that to 503.
+
+Abandoned requests are SHED: when a caller's ``submit(timeout=...)``
+wait expires, the request is marked abandoned and the worker skips it
+at flush time — no device dispatch is paid for a result nobody reads
+(counted on ``xgbtpu_reliability_shed_requests_total``).
 """
 
 from __future__ import annotations
@@ -28,7 +33,8 @@ class QueueFull(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("X", "output_margin", "done", "result", "error", "t0")
+    __slots__ = ("X", "output_margin", "done", "result", "error", "t0",
+                 "abandoned")
 
     def __init__(self, X: np.ndarray, output_margin: bool):
         self.X = X
@@ -37,6 +43,10 @@ class _Request:
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.t0 = time.perf_counter()
+        # set by submit() when its caller's wait timed out: the caller
+        # is gone, so the worker sheds the request instead of paying
+        # device dispatch for a result nobody will read
+        self.abandoned = False
 
 
 class MicroBatcher:
@@ -112,6 +122,12 @@ class MicroBatcher:
                 self.metrics.queue_rows.set(self._queued_rows)
             self._q.put(req)
         if not req.done.wait(timeout):
+            # mark-then-raise: the request still sits in the queue, but
+            # the worker will skip it at flush time (counted in
+            # reliability metrics as a shed request).  Benign race: if
+            # the flush already started, the result is computed and
+            # simply dropped — never a wrong answer to a later caller.
+            req.abandoned = True
             raise TimeoutError("prediction timed out")
         if self.metrics is not None:
             self.metrics.latency.observe(time.perf_counter() - req.t0)
@@ -160,27 +176,39 @@ class MicroBatcher:
             self._flush(batch)
 
     def _flush(self, batch: List[_Request]) -> None:
-        rows = sum(r.X.shape[0] for r in batch)
-        self._dequeue_rows(rows)
+        self._dequeue_rows(sum(r.X.shape[0] for r in batch))
+        # shed requests whose caller already timed out: their rows would
+        # cost device dispatch (and inflate the batch's bucket) for a
+        # result nobody is waiting on
+        live = [r for r in batch if not r.abandoned]
+        if len(live) < len(batch):
+            from xgboost_tpu.profiling import reliability_metrics
+            reliability_metrics().shed_requests.inc(len(batch) - len(live))
+            for r in batch:
+                if r.abandoned:
+                    r.done.set()
+            if not live:
+                return
+        rows = sum(r.X.shape[0] for r in live)
         if self.metrics is not None:
             self.metrics.batches.inc()
             self.metrics.batch_rows.observe(rows)
         try:
-            X = (batch[0].X if len(batch) == 1
-                 else np.concatenate([r.X for r in batch], axis=0))
-            out = self.predict_fn(X, output_margin=batch[0].output_margin)
+            X = (live[0].X if len(live) == 1
+                 else np.concatenate([r.X for r in live], axis=0))
+            out = self.predict_fn(X, output_margin=live[0].output_margin)
             off = 0
-            for r in batch:
+            for r in live:
                 n = r.X.shape[0]
                 r.result = out[off:off + n]
                 off += n
         except BaseException as e:  # propagate to every caller in the batch
             if self.metrics is not None:
-                self.metrics.errors.inc(len(batch))
-            for r in batch:
+                self.metrics.errors.inc(len(live))
+            for r in live:
                 r.error = e
         finally:
-            for r in batch:
+            for r in live:
                 r.done.set()
 
     # -------------------------------------------------------------- close

@@ -7,11 +7,21 @@ Endpoints (SERVING.md):
   ``{"predictions": [...], "model_version": v, "rows": n}``.
   ``?output_margin=1`` returns raw margins.  A full batch queue maps to
   HTTP 503 (the batcher's reject-with-backpressure contract).
-- ``GET /healthz`` — liveness + model version + queue depth + p50/p99.
-- ``GET /metrics`` — Prometheus text exposition (ServingMetrics).
+- ``GET /healthz`` — liveness + model version + queue depth + p50/p99,
+  plus the failure-path fields (RELIABILITY.md): drain ``state``,
+  ``status: degraded`` while the watched model file is poisoned,
+  ``reload_failures`` count and ``last_reload_error``.
+- ``GET /metrics`` — Prometheus text exposition (ServingMetrics +
+  the process-wide ReliabilityMetrics).
 - ``POST /-/reload`` — force one reload poll (also happens on the
   background poll timer); ``POST /-/rollback`` swaps the previous
   version back in.
+
+Shutdown is a drain state machine (``serving -> draining -> stopped``):
+SIGTERM (or :meth:`PredictServer.drain`) stops admitting ``/predict``
+with 503, waits for in-flight requests to finish (bounded by
+``drain_grace``), then exits — a rolling restart loses zero accepted
+requests.
 
 ``ThreadingHTTPServer`` gives one thread per connection; all of them
 funnel into the single MicroBatcher queue, which is where concurrency
@@ -21,7 +31,10 @@ turns into coalesced device batches.
 from __future__ import annotations
 
 import json
+import signal
+import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -53,7 +66,11 @@ def parse_csv_rows(text: str) -> np.ndarray:
 def parse_libsvm_rows(text: str, num_feature: int) -> np.ndarray:
     """libsvm rows -> (n, F) float32 with NaN for absent features.  A
     leading label token (no ':') is tolerated and ignored — serving
-    inputs are features-only, but clients often replay training files."""
+    inputs are features-only, but clients often replay training files.
+    A feature index beyond the model's width is a client error (400),
+    same as the CSV path's too-many-columns check — silently dropping
+    it would return confidently wrong predictions for a mis-deployed
+    client."""
     rows = []
     for line in text.splitlines():
         toks = line.split("#", 1)[0].split()
@@ -71,8 +88,11 @@ def parse_libsvm_rows(text: str, num_feature: int) -> np.ndarray:
     out = np.full((len(rows), num_feature), np.nan, np.float32)
     for i, feats in enumerate(rows):
         for idx, val in feats.items():
-            if 0 <= idx < num_feature:
-                out[i, idx] = val
+            if not 0 <= idx < num_feature:
+                raise ValueError(
+                    f"feature index {idx} out of range for a "
+                    f"{num_feature}-feature model")
+            out[i, idx] = val
     return out
 
 
@@ -102,13 +122,21 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         if url.path == "/healthz":
             reg: ModelRegistry = self.server.registry
+            ps: PredictServer = self.server.pserver
             m = self.server.metrics
             q = m.quantiles((0.5, 0.99))
+            # "degraded" = still serving, but the watched file is
+            # poisoned (its newest bytes cannot be loaded) — alerts fire
+            # while traffic keeps flowing on the last good model
             self._send_json(200, {
-                "status": "ok",
+                "status": "degraded" if reg.poisoned else "ok",
+                "state": ps.state,
                 "model_version": reg.version,
                 "queue_rows": self.server.batcher.queued_rows,
+                "inflight": ps.inflight,
                 "buckets_compiled": reg.engine.num_compiled,
+                "reload_failures": reg.reload_failures,
+                "last_reload_error": reg.last_reload_error,
                 "latency_p50_ms": round(q[0.5] * 1e3, 3),
                 "latency_p99_ms": round(q[0.99] * 1e3, 3),
             })
@@ -142,12 +170,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
             self._send_json(400, {"error": "bad Content-Length"})
             return
+        max_body = self.server.pserver.max_body_bytes
+        if length > max_body:
+            # reject-don't-buffer applies to the HTTP layer too: the
+            # bound is enforced BEFORE any body bytes are read, so an
+            # oversized post cannot balloon a handler thread
+            self.close_connection = True
+            self._send_json(413, {"error": f"request body {length} bytes "
+                                           f"exceeds limit {max_body}"})
+            return
         body = self.rfile.read(length).decode("utf-8", "replace")
         if url.path == "/predict":
             self._predict(url, body)
             return
         if url.path == "/-/reload":
-            reloaded = self.server.registry.check_reload()
+            # forced: bypasses the poisoned-fingerprint skip, so an
+            # operator can retry after a TRANSIENT build failure
+            reloaded = self.server.registry.check_reload(force=True)
             self._send_json(200, {"reloaded": reloaded,
                                   "model_version":
                                       self.server.registry.version})
@@ -161,6 +200,20 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(404, {"error": f"no route {url.path}"})
 
     def _predict(self, url, body: str) -> None:
+        ps: PredictServer = self.server.pserver
+        if not ps.enter_request():
+            # draining: load balancers read the 503 as "instance going
+            # away", retry elsewhere; requests already in flight finish
+            self.close_connection = True
+            self._send_json(503, {"error": "server is draining",
+                                  "state": ps.state})
+            return
+        try:
+            self._predict_admitted(url, body)
+        finally:
+            ps.exit_request()
+
+    def _predict_admitted(self, url, body: str) -> None:
         try:
             qs = parse_qs(url.query)
             fmt = qs.get("format", [None])[0]
@@ -210,22 +263,104 @@ class PredictServer:
     ``port=0`` binds an ephemeral port (tests); the bound port is on
     ``self.port``.  Use :meth:`start` for a background thread or
     :meth:`serve_forever` to block.
+
+    Lifecycle is a drain state machine: ``serving`` (admitting
+    ``/predict``) -> ``draining`` (new predictions get 503, in-flight
+    ones finish, ``/healthz`` still answers) -> ``stopped``.  SIGTERM
+    triggers it when :meth:`serve_forever` runs on the main thread;
+    :meth:`drain` triggers it programmatically.
     """
 
     def __init__(self, registry: ModelRegistry, batcher: MicroBatcher,
                  metrics, host: str = "127.0.0.1", port: int = 8080,
-                 quiet: bool = True):
+                 quiet: bool = True, drain_grace: float = 30.0,
+                 max_body_mb: float = 64.0):
         self.registry = registry
         self.batcher = batcher
         self.metrics = metrics
+        self.drain_grace = float(drain_grace)
+        self.max_body_bytes = int(max_body_mb * (1 << 20))
+        self.state = "serving"          # serving -> draining -> stopped
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._shut = False
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        # handler threads must not be able to pin the process: a wedged
+        # device call (the case the drain grace exists for) leaves its
+        # handler blocked in batcher.submit() forever, and non-daemon
+        # threads would keep the interpreter alive after main returns
+        self._httpd.daemon_threads = True
         self._httpd.registry = registry
         self._httpd.batcher = batcher
         self._httpd.metrics = metrics
         self._httpd.quiet = quiet
+        self._httpd.pserver = self
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
+    # -------------------------------------------------------- drain state
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    def enter_request(self) -> bool:
+        """Admission check + in-flight count, one atomic step (a drain
+        that begins between the two could otherwise miss a request).
+        False = draining/stopped, caller answers 503."""
+        with self._inflight_cv:
+            if self.state != "serving":
+                return False
+            self._inflight += 1
+            return True
+
+    def exit_request(self) -> None:
+        with self._inflight_cv:
+            self._inflight -= 1
+            self._inflight_cv.notify_all()
+
+    def drain(self, grace: Optional[float] = None) -> float:
+        """Stop admitting predictions, wait (bounded by ``grace``) for
+        in-flight ones to finish, then shut down.  Returns the drain
+        duration in seconds (also on the ``drain_seconds`` gauge)."""
+        from xgboost_tpu.profiling import reliability_metrics
+        grace = self.drain_grace if grace is None else float(grace)
+        t0 = time.perf_counter()
+        deadline = t0 + grace
+        with self._inflight_cv:
+            if self.state == "serving":
+                self.state = "draining"
+            while self._inflight > 0:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    print(f"[serving] drain grace ({grace:.1f}s) expired "
+                          f"with {self._inflight} request(s) in flight",
+                          file=sys.stderr)
+                    # the stragglers are wedged (their submit() has no
+                    # timeout); joining their daemon threads would block
+                    # forever and defeat the grace bound — skip the join
+                    # and let process exit reap them
+                    self._httpd.block_on_close = False
+                    break
+                self._inflight_cv.wait(left)
+        # the gauge lands BEFORE the listener closes, so a last /metrics
+        # scrape during the drain can observe it (and once more after,
+        # with the total, for embedders holding the object)
+        reliability_metrics().drain_seconds.set(time.perf_counter() - t0)
+        self.shutdown()
+        dur = time.perf_counter() - t0
+        reliability_metrics().drain_seconds.set(dur)
+        return dur
+
+    def _handle_sigterm(self, signum, frame) -> None:
+        # runs on the main thread, which is inside serve_forever's
+        # select loop: the actual drain+shutdown must happen elsewhere
+        # (shutdown() blocks until that very loop exits)
+        print("[serving] SIGTERM: draining (in-flight requests finish, "
+              "new /predict gets 503)", file=sys.stderr)
+        threading.Thread(target=self.drain, daemon=True,
+                         name="xgbtpu-drain").start()
+
+    # ---------------------------------------------------------- lifecycle
     def start(self) -> "PredictServer":
         self.registry.start()
         self._thread = threading.Thread(
@@ -236,6 +371,11 @@ class PredictServer:
 
     def serve_forever(self) -> None:
         self.registry.start()
+        if threading.current_thread() is threading.main_thread():
+            try:
+                signal.signal(signal.SIGTERM, self._handle_sigterm)
+            except ValueError:
+                pass  # exotic embedding; drain() stays available
         try:
             self._httpd.serve_forever()
         except KeyboardInterrupt:
@@ -244,6 +384,11 @@ class PredictServer:
             self.shutdown()
 
     def shutdown(self) -> None:
+        with self._inflight_cv:
+            if self._shut:
+                return
+            self._shut = True
+            self.state = "stopped"
         self.registry.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -258,14 +403,13 @@ def run_server(model_path: str, host: str = "127.0.0.1", port: int = 8080,
                max_batch_rows: int = 1024, max_wait_ms: float = 2.0,
                max_queue_rows: int = 8192, poll_sec: float = 1.0,
                keep_versions: int = 2, warmup: bool = True,
-               quiet: bool = False, block: bool = True
-               ) -> Optional[PredictServer]:
+               drain_sec: float = 30.0, max_body_mb: float = 64.0,
+               quiet: bool = False,
+               block: bool = True) -> Optional[PredictServer]:
     """Build the full serving stack for one model file and run it.
 
     With ``block=False`` the server runs on a background thread and the
     :class:`PredictServer` is returned (tests, embedding)."""
-    import sys
-
     from xgboost_tpu.profiling import ServingMetrics
     metrics = ServingMetrics()
     registry = ModelRegistry(model_path, keep_versions=keep_versions,
@@ -276,7 +420,8 @@ def run_server(model_path: str, host: str = "127.0.0.1", port: int = 8080,
                            max_wait_ms=max_wait_ms,
                            max_queue_rows=max_queue_rows, metrics=metrics)
     server = PredictServer(registry, batcher, metrics, host=host, port=port,
-                           quiet=quiet)
+                           quiet=quiet, drain_grace=drain_sec,
+                           max_body_mb=max_body_mb)
     if not quiet:
         eng = registry.engine
         print(f"[serving] model {model_path} (v{registry.version}, "

@@ -15,6 +15,13 @@ reference" design, SERVING.md):
 4. the old (version, engine) pair is pushed onto a bounded rollback
    ring (``keep_versions`` deep); :meth:`rollback` swaps it straight
    back without touching disk.
+
+Failure paths (RELIABILITY.md): file bytes are CRC-verified BEFORE any
+engine build, and content that fails to load is remembered as a
+poisoned fingerprint — hashed-and-rejected on later polls instead of
+re-built and re-warmed every second — until the file changes again.
+``last_reload_error``/``reload_failures`` feed the HTTP ``/healthz``
+degraded state.
 """
 
 from __future__ import annotations
@@ -28,6 +35,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from xgboost_tpu.reliability import faults
+from xgboost_tpu.reliability.integrity import read_file, verify_model_bytes
 from xgboost_tpu.serving.engine import PredictEngine
 
 
@@ -66,6 +75,13 @@ class ModelRegistry:
         self._engine: Optional[PredictEngine] = None
         self._previous: deque = deque(maxlen=max(0, self.keep_versions))
         self._fp: Optional[Tuple] = None
+        # the failure-path ledger: the fingerprint of content that
+        # failed to load (so it is never re-built until the file changes
+        # AGAIN), plus what /healthz reports about it
+        self._poisoned: Optional[Tuple] = None
+        self.last_reload_error: Optional[str] = None
+        self.reload_failures = 0
+        self.build_attempts = 0
         self._reload_lock = threading.Lock()   # one reload at a time
         self._swap_lock = threading.Lock()     # guards engine/version swap
         self._stop = threading.Event()
@@ -73,37 +89,45 @@ class ModelRegistry:
         self._load_initial()
 
     # ------------------------------------------------------------- loading
-    def _fingerprint(self, fast: bool = False) -> Tuple:
-        """(mtime_ns, size, sha256).  With ``fast=True`` and an
-        unchanged stat, the stored hash is reused — the per-poll fast
-        path never reads the file; the hash is only recomputed to
-        confirm an apparent change (a touch with identical bytes must
-        NOT trigger a reload)."""
+    def _read_fingerprinted(self) -> Tuple[bytes, Tuple]:
+        """One read of the watched file -> (raw bytes, (mtime_ns, size,
+        sha256)).  The same bytes feed verification AND the engine
+        build, so the content that was hashed is the content that
+        loads — no torn-rewrite race between a hash pass and a second
+        read."""
         st = os.stat(self.path)
-        if (fast and self._fp is not None
-                and (st.st_mtime_ns, st.st_size) == self._fp[:2]):
-            return self._fp
-        h = hashlib.sha256()
-        with open(self.path, "rb") as f:
-            for chunk in iter(lambda: f.read(1 << 20), b""):
-                h.update(chunk)
-        return (st.st_mtime_ns, st.st_size, h.hexdigest())
+        raw = read_file(self.path)
+        return raw, (st.st_mtime_ns, st.st_size,
+                     hashlib.sha256(raw).hexdigest())
 
-    def _build_engine(self) -> Tuple[PredictEngine, Tuple]:
-        fp = self._fingerprint()
-        engine = PredictEngine(self.path, metrics=self.metrics,
+    def _build_engine(self, raw: bytes) -> PredictEngine:
+        """Verify + build + warm an engine from raw file bytes.  Raises
+        ModelIntegrityError on torn/bit-flipped content BEFORE any
+        device work is spent on it."""
+        self.build_attempts += 1
+        payload = verify_model_bytes(raw, name=self.path)
+        faults.check("reload", path=self.path)  # chaos seam
+        engine = PredictEngine(bytes(payload), metrics=self.metrics,
                                **self.engine_kwargs)
         if self.warmup:
             engine.warmup()
-        return engine, fp
+        return engine
 
     def _load_initial(self) -> None:
-        engine, fp = self._build_engine()
+        raw, fp = self._read_fingerprinted()
+        engine = self._build_engine(raw)
         with self._swap_lock:
             self._engine, self._fp = engine, fp
             self.version = 1
         if self.metrics is not None:
             self.metrics.model_version.set(self.version)
+
+    @property
+    def poisoned(self) -> bool:
+        """True while the on-disk file is known-bad (the last reload
+        failed and the file has not changed since) — the serving stack
+        is healthy but DEGRADED: it cannot pick up the newest bytes."""
+        return self._poisoned is not None
 
     # --------------------------------------------------------------- state
     @property
@@ -125,38 +149,87 @@ class ModelRegistry:
         return VersionedArray.tag(out, version)
 
     # -------------------------------------------------------------- reload
-    def check_reload(self) -> bool:
+    def check_reload(self, force: bool = False) -> bool:
         """Poll once: reload + swap if the file content changed.
-        Returns True when a new model went live.  A failed load (e.g. a
-        half-written file racing the poll) keeps the old model serving
-        and retries on the next poll."""
+        Returns True when a new model went live.
+
+        Failure paths (RELIABILITY.md): a load that fails — torn file
+        racing the poll, CRC mismatch, injected fault — keeps the old
+        model serving and POISONS the new content's fingerprint: the
+        bad bytes are hashed-and-rejected (cheap) on later polls
+        instead of re-built and re-warmed (a full bucket compile)
+        every second, until the file changes again.  ``/healthz``
+        surfaces ``last_reload_error`` while poisoned.
+
+        ``force=True`` (the ``POST /-/reload`` endpoint) bypasses BOTH
+        short-circuits — the poisoned skip and the stat fast path — and
+        re-reads the file: the operator's escape hatch when the failure
+        was transient (device OOM during warmup, injected fault) rather
+        than bad bytes, and the only way to pick up a rewrite that
+        preserved mtime+size (``rsync -a`` / ``cp -p`` of a same-sized
+        model), which the stat-compare poll is blind to by design."""
         with self._reload_lock:
             try:
-                fp = self._fingerprint(fast=True)
+                st = os.stat(self.path)
             except OSError:
                 return False  # file mid-replace; next poll sees the result
-            if fp == self._fp:
+            stat = (st.st_mtime_ns, st.st_size)
+            if (not force and self._fp is not None
+                    and stat == self._fp[:2]):
+                return False  # per-poll fast path: stat unchanged, no read
+            if (not force and self._poisoned is not None
+                    and stat == self._poisoned[:2]):
+                # known-bad file, not even touched since: skip the read
+                self._count_poisoned_skip()
+                return False
+            try:
+                raw, fp = self._read_fingerprinted()
+            except OSError:
                 return False
             if self._fp is not None and fp[2] == self._fp[2]:
                 self._fp = fp  # touched but byte-identical: not a reload
+                if self._poisoned is not None:
+                    # the file was rolled BACK to the live content (an
+                    # operator undoing a bad push): it is no longer
+                    # known-bad — clear the degraded state
+                    self._poisoned = None
+                    self.last_reload_error = None
+                return False
+            if (not force and self._poisoned is not None
+                    and fp[2] == self._poisoned[2]):
+                # rewritten with the SAME bad bytes: refresh the stat so
+                # the next poll short-circuits, but do not rebuild
+                self._poisoned = fp
+                self._count_poisoned_skip()
                 return False
             try:
-                engine, fp = self._build_engine()
+                engine = self._build_engine(raw)
             except Exception as e:
+                self.reload_failures += 1
+                self.last_reload_error = f"{type(e).__name__}: {e}"
+                self._poisoned = fp
                 if self.metrics is not None:
                     self.metrics.reload_errors.inc()
-                print(f"[serving] reload failed, keeping v{self.version}: "
-                      f"{e}", file=sys.stderr)
+                print(f"[serving] reload failed, keeping v{self.version} "
+                      f"(file poisoned until it changes): {e}",
+                      file=sys.stderr)
                 return False
             with self._swap_lock:
                 self._previous.append((self.version, self._engine))
                 self._engine, self._fp = engine, fp
+                self._poisoned = None
+                self.last_reload_error = None
                 self.version += 1
                 v = self.version
             if self.metrics is not None:
                 self.metrics.reloads.inc()
                 self.metrics.model_version.set(v)
             return True
+
+    @staticmethod
+    def _count_poisoned_skip() -> None:
+        from xgboost_tpu.profiling import reliability_metrics
+        reliability_metrics().poisoned_reloads.inc()
 
     def rollback(self) -> bool:
         """Swap the most recent previous version back in (no disk I/O —
