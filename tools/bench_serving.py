@@ -43,29 +43,28 @@ def _train_model():
                      xgb.DMatrix(X, label=y), ROUNDS)
 
 
-def _quantile(xs, q):
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(q * len(xs)))]
-
-
 def bench_direct(engine, rng):
-    """Engine-only path: one request at a time, per-size stats."""
+    """Engine-only path: one request at a time, per-size stats.  The
+    p50/p99 come from the unified metric registry's latency histogram
+    (one fresh ``ServingMetrics`` per size), not an ad-hoc sorted-list
+    recompute — the bench reports exactly what a scrape would."""
     per_size = {}
     for n in ROWS_PER_REQ:
+        metrics = ServingMetrics()
         Xs = [rng.rand(n, N_FEAT).astype(np.float32) for _ in range(32)]
         engine.predict(Xs[0])  # bucket already warm; prime np caches
-        lat = []
         t0 = time.perf_counter()
         for i in range(REQS_PER_SIZE):
             s = time.perf_counter()
             engine.predict(Xs[i % len(Xs)])
-            lat.append(time.perf_counter() - s)
+            metrics.latency.observe(time.perf_counter() - s)
         wall = time.perf_counter() - t0
+        q = metrics.quantiles((0.5, 0.99))
         per_size[n] = {
             "requests_per_sec": round(REQS_PER_SIZE / wall, 1),
             "rows_per_sec": round(REQS_PER_SIZE * n / wall, 1),
-            "p50_ms": round(_quantile(lat, 0.50) * 1e3, 3),
-            "p99_ms": round(_quantile(lat, 0.99) * 1e3, 3),
+            "p50_ms": round(q[0.5] * 1e3, 3),
+            "p99_ms": round(q[0.99] * 1e3, 3),
         }
     return per_size
 
@@ -80,18 +79,14 @@ def bench_concurrent(engine, rng):
     reqs_per_client = REQS_PER_SIZE // 2
     Xs = [rng.rand(1, N_FEAT).astype(np.float32) for _ in range(64)]
     barrier = threading.Barrier(CONCURRENT_CLIENTS + 1)
-    lat = []
-    lock = threading.Lock()
 
     def client():
         barrier.wait()
-        mine = []
         for i in range(reqs_per_client):
-            s = time.perf_counter()
+            # the batcher observes each request's latency into
+            # metrics.latency; quantiles below read the same histogram
+            # the /metrics endpoint renders
             batcher.submit(Xs[i % len(Xs)])
-            mine.append(time.perf_counter() - s)
-        with lock:
-            lat.extend(mine)
 
     ts = [threading.Thread(target=client)
           for _ in range(CONCURRENT_CLIENTS)]
@@ -104,11 +99,12 @@ def bench_concurrent(engine, rng):
     wall = time.perf_counter() - t0
     total = reqs_per_client * CONCURRENT_CLIENTS
     batcher.close()
+    q = metrics.quantiles((0.5, 0.99))
     return {
         "clients": CONCURRENT_CLIENTS,
         "requests_per_sec": round(total / wall, 1),
-        "p50_ms": round(_quantile(lat, 0.50) * 1e3, 3),
-        "p99_ms": round(_quantile(lat, 0.99) * 1e3, 3),
+        "p50_ms": round(q[0.5] * 1e3, 3),
+        "p99_ms": round(q[0.99] * 1e3, 3),
         "batches": int(metrics.batches.value),
         "mean_batch_rows": round(total / max(metrics.batches.value, 1), 2),
     }

@@ -43,6 +43,10 @@ Tasks (task=...):
   serve   HTTP prediction service (model_in=...; see parameters below,
           or `python -m xgboost_tpu.serving --help`)
 
+Observability (OBSERVABILITY.md): obs_log=PATH appends a crash-safe
+JSONL timeline (render: tools/obs_report.py); metrics_port=N serves
+live /metrics + /healthz during task=train (0 = ephemeral, -1 = off).
+
 task=serve parameters:
 {serve_params}
 """
@@ -231,8 +235,34 @@ class BoostLearnTask:
                 os._exit(41)
         return self._dispatch()
 
+    def _setup_obs(self) -> None:
+        """Arm the observability layer (OBSERVABILITY.md) from params:
+        ``obs_log=`` opens the JSONL event log (per-rank suffix under
+        the multi-host launcher, so timelines never interleave), and
+        ``metrics_port=`` serves live ``/metrics`` + ``/healthz`` from
+        a daemon thread (rank r binds port+r — per-rank export of the
+        collective stats).  Env equivalents: XGBTPU_OBS_LOG, XGBTPU_OBS.
+        """
+        from xgboost_tpu import obs
+        params = self._params_dict()
+        obs_path = params.get("obs_log") or os.environ.get("XGBTPU_OBS_LOG")
+        if obs_path:
+            if self._distributed and self.rank != 0:
+                obs_path = f"{obs_path}.rank{self.rank}"
+            obs.configure_log(obs_path)
+        port = int(params.get("metrics_port", -1))
+        if port >= 0 and self.task == "train":
+            srv = obs.start_metrics_server(
+                port=port + self.rank if port > 0 else 0,
+                rank=self.rank)
+            if self.silent < 2:
+                print(f"[obs] training metrics on "
+                      f"http://{srv.host}:{srv.port}/metrics "
+                      f"(rank {self.rank})", file=sys.stderr)
+
     def _dispatch(self) -> int:
         """Task dispatch after param parsing + distributed init."""
+        self._setup_obs()
         if self.task == "train":
             if not self.mock_spec:
                 return self.task_train()
@@ -511,14 +541,23 @@ def _save_checkpoint(ckpt_dir: str, bst, version: int) -> None:
     """Per-round checkpoint (the rabit::CheckPoint analog — the model
     is tiny, so a full save per round is cheap; SURVEY.md §5.3).
     ``save_model`` itself is atomic + CRC-footered (reliability/
-    integrity.py), so a crash mid-save can never tear a ring member."""
-    os.makedirs(ckpt_dir, exist_ok=True)
-    bst.save_model(_ckpt_path(ckpt_dir, version))
-    # keep only the two most recent checkpoints (ring of replicas analog)
-    kept = sorted(f for f in os.listdir(ckpt_dir)
-                  if re.fullmatch(r"ckpt-\d{6}\.model", f))
-    for stale in kept[:-2]:
-        os.remove(os.path.join(ckpt_dir, stale))
+    integrity.py), so a crash mid-save can never tear a ring member.
+    Cost is accounted like the reference's report_stats checkpoint
+    line: a ``ckpt.save`` span in the event log and the
+    ``xgbtpu_training_checkpoint_*`` counters."""
+    from xgboost_tpu.obs import span, training_metrics
+    t0 = time.perf_counter()
+    with span("ckpt.save", version=version):
+        os.makedirs(ckpt_dir, exist_ok=True)
+        bst.save_model(_ckpt_path(ckpt_dir, version))
+        # keep only the two most recent checkpoints (ring replica analog)
+        kept = sorted(f for f in os.listdir(ckpt_dir)
+                      if re.fullmatch(r"ckpt-\d{6}\.model", f))
+        for stale in kept[:-2]:
+            os.remove(os.path.join(ckpt_dir, stale))
+    tm = training_metrics()
+    tm.checkpoints.inc()
+    tm.checkpoint_seconds.inc(time.perf_counter() - t0)
 
 
 def _load_checkpoint(ckpt_dir: str, bst, params: dict):
@@ -529,6 +568,7 @@ def _load_checkpoint(ckpt_dir: str, bst, params: dict):
     loads (reference xgboost_main.cpp:176-183)."""
     if not os.path.isdir(ckpt_dir):
         return bst, 0
+    from xgboost_tpu.obs import event, span
     found = sorted(f for f in os.listdir(ckpt_dir)
                    if re.fullmatch(r"ckpt-\d{6}\.model", f))
     for name in reversed(found):
@@ -564,12 +604,14 @@ def _load_checkpoint(ckpt_dir: str, bst, params: dict):
                 # exists to survive
                 q_msg = f"quarantine failed ({qe}); left in place"
             reliability_metrics().ring_fallbacks.inc()
+            event("ckpt.ring_fallback", member=name, error=str(e))
             print(f"[ckpt] {name} failed verification ({e}); {q_msg}, "
                   "falling back to the older ring member",
                   file=sys.stderr)
             continue
-        bst.load_raw(payload, name=path)  # the verified buffer itself
-        bst.set_param(params)
+        with span("ckpt.load", member=name, version=int(name[5:11])):
+            bst.load_raw(payload, name=path)  # the verified buffer
+            bst.set_param(params)
         return bst, int(name[5:11])
     return bst, 0
 

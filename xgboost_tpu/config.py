@@ -139,6 +139,15 @@ class TrainParam:
     # 2 = also capture a jax.profiler trace into profile_dir
     profile: int = 0
     profile_dir: str = ""
+    # observability (OBSERVABILITY.md): obs_log= appends spans/events
+    # to a crash-safe JSONL timeline (tools/obs_report.py renders it;
+    # XGBTPU_OBS_LOG is the env equivalent); metrics_port= serves live
+    # /metrics + /healthz during task=train from a daemon thread
+    # (0 = ephemeral port, printed at startup; -1 = off).  Either one
+    # enables per-round phase instrumentation — same cost contract as
+    # profile=1 (a device barrier per phase, no fused round loop).
+    obs_log: str = ""
+    metrics_port: int = -1
 
     # -- gblinear params (reference src/gbm/gblinear-inl.hpp) --
     lambda_bias: float = 0.0

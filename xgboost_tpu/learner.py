@@ -738,14 +738,23 @@ class Booster:
     @property
     def profiler(self):
         """Lazily created RoundProfiler when param profile>=1 (the
-        report_stats analog, SURVEY.md §5.1)."""
+        report_stats analog, SURVEY.md §5.1) — or, at level 0, when the
+        observability layer is on (``obs_log=``/``metrics_port=``/
+        ``XGBTPU_OBS=1``): phase spans, the event-log timeline and the
+        live training metrics all need the per-phase boundaries, which
+        also means per-round host control (no fused multi-round launch)
+        and a device barrier per phase — the same cost contract as
+        ``profile=1`` (PROFILE.md)."""
+        if getattr(self, "_profiler", None) is not None:
+            return self._profiler
         if self.param.profile <= 0:
-            return None
-        if getattr(self, "_profiler", None) is None:
-            from xgboost_tpu.profiling import RoundProfiler
-            self._profiler = RoundProfiler(
-                self.param.profile, self.param.profile_dir or None)
-            self._profiler.start()
+            from xgboost_tpu import obs
+            if not obs.phases_enabled():
+                return None
+        from xgboost_tpu.obs import RoundProfiler
+        self._profiler = RoundProfiler(
+            self.param.profile, self.param.profile_dir or None)
+        self._profiler.start()
         return self._profiler
 
     # ------------------------------------------------------------- training
@@ -1133,7 +1142,13 @@ class Booster:
                 preds = tr[:, 0] if tr.shape[1] == 1 else tr
                 mname, val = feval(preds, dmat)
                 parts.append(f"{name}-{mname}:{val:.6f}")
-        return "\t".join(parts)
+        msg = "\t".join(parts)
+        # latest eval scores ride the training metrics as gauges
+        # (xgbtpu_training_eval_score{key="train-error"}), scrapeable
+        # mid-run via metrics_port= (OBSERVABILITY.md)
+        from xgboost_tpu.obs import training_metrics
+        training_metrics().observe_eval(_parse_eval(msg))
+        return msg
 
     def _eval_sharded(self, dmat, entry, name: str, parts: List[str],
                       feval) -> None:
@@ -1239,17 +1254,22 @@ class Booster:
                 sys.stdout.buffer.write(payload)
                 sys.stdout.buffer.flush()
                 return
+        from xgboost_tpu.obs import span
         from xgboost_tpu.reliability.integrity import (add_footer,
                                                        atomic_write)
-        atomic_write(path, add_footer(payload))
+        with span("model.save", path=path, bytes=len(payload)):
+            atomic_write(path, add_footer(payload))
 
     def load_model(self, path: str):
+        from xgboost_tpu.obs import span
         from xgboost_tpu.reliability.integrity import (read_file,
                                                        verify_model_bytes)
-        raw = read_file(path)
-        # strips + checks the CRC footer; raises ModelIntegrityError on
-        # torn/bit-flipped files, warns once on footer-less legacy files
-        self.load_raw(verify_model_bytes(raw, name=path), name=path)
+        with span("model.load", path=path):
+            raw = read_file(path)
+            # strips + checks the CRC footer; raises ModelIntegrityError
+            # on torn/bit-flipped files, warns once on footer-less
+            # legacy files
+            self.load_raw(verify_model_bytes(raw, name=path), name=path)
 
     def load_raw(self, raw: bytes, name: str = "<buffer>"):
         """Load a model from an in-memory buffer (reference

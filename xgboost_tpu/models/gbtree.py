@@ -15,6 +15,7 @@ from __future__ import annotations
 import functools
 import os
 import sys
+import time
 from typing import Callable, List, Optional, Tuple
 
 import jax
@@ -203,6 +204,21 @@ class GBTree:
                 self._split_finder_cache = False
         return self._split_finder_cache or None
 
+    def _comm_bytes(self, n_feat: int, mesh=None) -> float:
+        """Logical HISTOGRAM-allreduce payload estimate per tree-growth
+        launch (the report_stats bytes analog, obs/comm.py): each level
+        reduces per-node (F, n_bin, 2) f32 histogram partials and the
+        node count doubles per level.  0 when no row mesh is active —
+        single-chip runs reduce nothing, and column split never
+        allreduces histograms (its SplitDecision gathers are accounted
+        by colsplit.py itself as "allgather").  An estimate of what the
+        reference would have shipped over rabit — ICI wire bytes are
+        not observable host-side."""
+        if mesh is None:
+            return 0.0
+        return float(((1 << self.cfg.max_depth) - 1)
+                     * n_feat * self.cfg.n_bin * 2 * 4)
+
     @property
     def num_trees(self) -> int:
         return len(self._trees_list) + (
@@ -267,13 +283,18 @@ class GBTree:
                 and not os.environ.get("XGBTPU_SEQ_BOOST")):
             return self._do_boost_vmapped(binned, gh, key, row_valid, mesh,
                                           K, npar, do_prune, root)
+        from xgboost_tpu.obs import comm
+        comm_nbytes = self._comm_bytes(binned.shape[1], mesh)
         for k in range(K):
             delta_k = None
             for t in range(npar):
                 # one "seqno" per tree-growth launch (the collective unit:
-                # psum histograms / split reduce happen inside)
-                mock.collective()
+                # psum histograms / split reduce happen inside); the seam
+                # also counts it into the per-round collective stats, and
+                # the timed() wrapper below adds the launch wall seconds
+                mock.collective(nbytes=comm_nbytes)
                 tkey = jax.random.fold_in(key, k * npar + t)
+                _t_launch = time.perf_counter()
                 if col_mesh is not None:
                     if self._split_finder() is not None:
                         raise NotImplementedError(
@@ -304,6 +325,14 @@ class GBTree:
                         self.n_cuts_dev, self.cfg, row_valid,
                         split_finder=self._split_finder(), root=root,
                         binned_t=binned_t)
+                # host-side launch wall time of the collective unit the
+                # seam counted above (count=0: no double count).  Under
+                # column split the launch is already timed inside
+                # grow_tree_colsplit as "allgather" — adding it here too
+                # would double the total comm seconds.
+                if col_mesh is None:
+                    comm.record("allreduce", count=0,
+                                seconds=time.perf_counter() - _t_launch)
                 if do_prune:
                     tree, resolve = prune_tree(tree, self.param.gamma,
                                                self.cfg.n_roots)
@@ -338,11 +367,16 @@ class GBTree:
                 "num_roots > 1 is not supported by the exact grower")
         new_trees: List[TreeArrays] = []
         deltas = []
+        from xgboost_tpu.obs import comm
         for k in range(K):
             delta_k = None
             for t in range(npar):
+                # exact mode reduces SplitEntry tuples + routing
+                # bitmaps, not histograms: count the launch, skip the
+                # payload estimate
                 mock.collective()
                 tkey = jax.random.fold_in(key, k * npar + t)
+                _t_launch = time.perf_counter()
                 rk, uq = exact_ranks if exact_ranks is not None \
                     else (None, None)
                 if col_mesh is not None:
@@ -357,6 +391,8 @@ class GBTree:
                     tree, row_leaf = grow_tree_exact(
                         tkey, X, gh[:, k, :], self.cfg, row_valid,
                         has_missing=has_missing, rank_t=rk, uniq=uq)
+                comm.record("allreduce", count=0,
+                            seconds=time.perf_counter() - _t_launch)
                 if do_prune:
                     tree, resolve = prune_tree(tree, self.param.gamma)
                     d = table_lookup(tree.leaf_value[jnp.asarray(resolve)],
@@ -388,9 +424,14 @@ class GBTree:
         # keep the seqno space identical to the sequential path (one per
         # tree) so mock fault coordinates fire regardless of backend; a
         # hit kills the round before the batched launch, which recovery
-        # treats the same as a mid-round death (partial state discarded)
+        # treats the same as a mid-round death (partial state discarded).
+        # The comm stats inherit the same count space (one logical
+        # allreduce per tree, even though the launch is batched).
+        from xgboost_tpu.obs import comm
+        comm_nbytes = self._comm_bytes(binned.shape[1], mesh)
         for _ in range(K * npar):
-            mock.collective()
+            mock.collective(nbytes=comm_nbytes)
+        _t_launch = time.perf_counter()
 
         T = K * npar
         keys = jnp.stack([jax.random.fold_in(key, i) for i in range(T)])
@@ -416,6 +457,8 @@ class GBTree:
                                  split_finder=self._split_finder(),
                                  root=root)
             stacked, row_leafs, ds = jax.vmap(one)(keys, gh_t)
+        comm.record("allreduce", count=0,
+                    seconds=time.perf_counter() - _t_launch)
 
         new_trees = list(_unstack_trees(stacked, T))
         if do_prune:
@@ -481,6 +524,18 @@ class GBTree:
         npar = max(1, self.param.num_parallel_tree)
         label = info.label_dev()
         weight = info.weight_dev(margin.shape[0])
+        # the fused scan still performs one logical histogram allreduce
+        # per tree; keep the comm/seqno count space identical to the
+        # per-round path (the injector is never armed here — fused
+        # launches are ineligible while mock.active())
+        from xgboost_tpu.obs import comm
+        from xgboost_tpu.parallel import mock
+        comm_nbytes = self._comm_bytes(binned.shape[1], mesh)
+        for r in range(n_rounds):
+            mock.begin_round(first_iteration + r)
+            for _ in range(K * npar):
+                mock.collective(nbytes=comm_nbytes)
+        _t_launch = time.perf_counter()
         margin_f, stacks = _scan_rounds(
             binned, margin, label, weight,
             jax.random.PRNGKey(self.param.seed),
@@ -488,6 +543,8 @@ class GBTree:
             self.n_cuts_dev, row_valid, binned_t,
             n_rounds=n_rounds, K=K, npar=npar, cfg=self.cfg,
             split_finder=self._split_finder(), grad_fn=grad_fn, mesh=mesh)
+        comm.record("allreduce", count=0,
+                    seconds=time.perf_counter() - _t_launch)
         # flatten (n_rounds, K*npar, ...) -> (T_new, ...) and install the
         # full-ensemble stack cache directly: prediction then reuses the
         # scan's own output instead of re-stacking T per-tree slices
@@ -541,17 +598,22 @@ class GBTree:
                     and self.param.gamma > 0.0)
         K = max(1, self.param.num_output_group)
         npar = max(1, self.param.num_parallel_tree)
+        from xgboost_tpu.obs import comm
         from xgboost_tpu.parallel import mock
         gh = jnp.asarray(gh)
+        comm_nbytes = self._comm_bytes(dmat.num_col, mesh)
         deltas = jnp.zeros((dmat.num_row, K), jnp.float32)
         for k in range(K):
             for t in range(npar):
-                mock.collective()
+                mock.collective(nbytes=comm_nbytes)
                 tkey = jax.random.fold_in(key, k * npar + t)
+                _t_launch = time.perf_counter()
                 tree = grow_tree_paged(tkey, dmat, gh[:, k, :],
                                        self.cut_values_dev, self.n_cuts_dev,
                                        self.cfg, mesh=mesh,
                                        split_finder=self._split_finder())
+                comm.record("allreduce", count=0,
+                            seconds=time.perf_counter() - _t_launch)
                 if do_prune:
                     tree, _ = prune_tree(tree, self.param.gamma)
                 d_k = jnp.concatenate(

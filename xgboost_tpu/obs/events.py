@@ -1,0 +1,138 @@
+"""Crash-safe structured event log: append-only JSONL.
+
+The durable half of the observability layer (OBSERVABILITY.md): spans
+(obs/trace.py, obs/profiler.py) and discrete events (reload, drain,
+integrity failure, fault injection) append one JSON object per line to
+a log file, so a run that dies leaves a replayable timeline —
+``tools/obs_report.py`` renders it into a per-round / per-request text
+view.
+
+Crash-safety discipline:
+
+- every line is ``write()`` + ``flush()`` — a process crash loses at
+  most the line being formatted (the kernel holds flushed bytes);
+- ``fsync`` is throttled (default at most once per second) so a
+  per-request serving span cannot turn into a per-request disk sync;
+- rotation reuses :func:`reliability.integrity.atomic_write`'s fsync
+  discipline: fsync the live file, ``os.replace`` it to ``<path>.1``,
+  fsync the directory, reopen — a crash mid-rotation leaves either the
+  old live file or the rotated file, never a torn rename.
+
+Configuration: :func:`configure_log` (CLI ``obs_log=`` / serving
+embedders) or the ``XGBTPU_OBS_LOG`` env var (read lazily on first
+use, so subprocess chaos/mp workers inherit it).  Unconfigured, every
+emit is a cheap no-op.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class EventLog:
+    """One append-only JSONL sink with throttled fsync and size-based
+    rotation."""
+
+    def __init__(self, path: str, rotate_bytes: int = 64 << 20,
+                 fsync_interval_s: float = 1.0):
+        self.path = os.fspath(path)
+        self.rotate_bytes = int(rotate_bytes)
+        self.fsync_interval_s = float(fsync_interval_s)
+        self._lock = threading.Lock()
+        self._last_fsync = 0.0
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "ab")
+
+    # -------------------------------------------------------------- emit
+    def emit(self, record: dict) -> None:
+        """Append one record (a dict; non-JSON values fall back to
+        ``str``).  Never raises into the instrumented code path: a full
+        disk degrades observability, not training."""
+        try:
+            line = json.dumps(record, separators=(",", ":"),
+                              default=str).encode() + b"\n"
+        except Exception:
+            return
+        with self._lock:
+            try:
+                self._f.write(line)
+                self._f.flush()
+                now = time.monotonic()
+                if now - self._last_fsync >= self.fsync_interval_s:
+                    os.fsync(self._f.fileno())
+                    self._last_fsync = now
+                if self._f.tell() >= self.rotate_bytes:
+                    self._rotate_locked()
+            except (OSError, ValueError):
+                pass
+
+    def _rotate_locked(self) -> None:
+        """Rotate ``path`` -> ``path.1`` (one generation kept) with the
+        atomic_write fsync discipline."""
+        os.fsync(self._f.fileno())
+        self._f.close()
+        os.replace(self.path, self.path + ".1")
+        d = os.path.dirname(os.path.abspath(self.path))
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        self._f = open(self.path, "ab")
+        self._last_fsync = time.monotonic()
+
+    # ------------------------------------------------------------- close
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except (OSError, ValueError):
+                pass
+            try:
+                self._f.close()
+            except (OSError, ValueError):
+                pass
+
+
+_UNSET = object()
+_log = _UNSET  # _UNSET -> consult env once; None -> explicitly off
+_log_lock = threading.Lock()
+
+
+def configure_log(path: Optional[str], rotate_bytes: int = 64 << 20,
+                  fsync_interval_s: float = 1.0) -> Optional[EventLog]:
+    """Install (or with ``path=None`` remove) the process-wide event
+    log.  Returns the installed :class:`EventLog` (or None)."""
+    global _log
+    with _log_lock:
+        if _log not in (_UNSET, None):
+            _log.close()
+        _log = (EventLog(path, rotate_bytes, fsync_interval_s)
+                if path else None)
+        return _log
+
+
+def get_log() -> Optional[EventLog]:
+    """The process-wide event log, or None when logging is off.  First
+    call consults ``XGBTPU_OBS_LOG`` so subprocesses armed via the
+    environment log without any code change."""
+    global _log
+    if _log is _UNSET:
+        with _log_lock:
+            if _log is _UNSET:
+                env = os.environ.get("XGBTPU_OBS_LOG")
+                _log = EventLog(env) if env else None
+    return _log
+
+
+def emit(record: dict) -> None:
+    """Append one record to the process-wide log (no-op when off)."""
+    log = get_log()
+    if log is not None:
+        log.emit(record)

@@ -1,0 +1,511 @@
+"""Prometheus-style metric primitives and the process-wide registry.
+
+This is the metrics half of the observability layer (OBSERVABILITY.md):
+the :class:`Counter`/:class:`Gauge`/:class:`Histogram` primitives that
+``xgboost_tpu.serving`` introduced, plus labeled families, plus ONE
+process-wide :class:`MetricsRegistry` that every metric group —
+:class:`ServingMetrics`, :class:`ReliabilityMetrics`, the training-side
+:class:`TrainingMetrics`, and the collective-seam counters
+(:mod:`xgboost_tpu.obs.comm`) — registers into, so a single
+``render()`` covers the whole process regardless of which subsystems
+are active.  The reference's analog is ``report_stats``
+(``subtree/rabit/src/allreduce_mock.h:52-56,87-95``): one place that
+accounts for allreduce time and checkpoint cost per version.
+
+``xgboost_tpu.profiling`` re-exports everything here for backward
+compatibility.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# latency buckets in seconds: 0.5ms .. 5s, roughly x2 per step
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+# batch-size buckets in rows: powers of two
+_ROWS_BUCKETS = tuple(float(1 << i) for i in range(15))
+# per-round wall-time buckets in seconds: 1ms .. 60s
+_ROUND_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                  0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
+
+
+def _fmt(v: float) -> str:
+    return f"{int(v)}" if float(v).is_integer() else repr(float(v))
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+class Counter:
+    """Monotonic counter (Prometheus ``counter``)."""
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name, self.help = name, help_text
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._v += v
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {_fmt(self._v)}\n")
+
+
+class Gauge:
+    """Settable value (Prometheus ``gauge``)."""
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name, self.help = name, help_text
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._v += v
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {_fmt(self._v)}\n")
+
+
+class LabeledCounter:
+    """One counter FAMILY with a single label dimension — e.g.
+    ``xgbtpu_training_phase_seconds_total{phase="grow"}``.  The family
+    renders one HELP/TYPE header and one sample per observed label
+    value, which is what scrapers (and the exposition lint test)
+    expect of labeled families."""
+
+    def __init__(self, name: str, label: str, help_text: str = ""):
+        self.name, self.label, self.help = name, label, help_text
+        self._v: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, label_value: str, v: float = 1.0) -> None:
+        with self._lock:
+            self._v[label_value] = self._v.get(label_value, 0.0) + v
+
+    def value(self, label_value: str) -> float:
+        return self._v.get(label_value, 0.0)
+
+    def values(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._v)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._v.items())
+        for lv, v in items:
+            lines.append(f'{self.name}{{{self.label}="{_escape_label(lv)}"}}'
+                         f' {_fmt(v)}')
+        return "\n".join(lines) + "\n"
+
+
+class LabeledGauge:
+    """Gauge family with one label dimension (e.g. eval scores keyed by
+    ``set-metric``)."""
+
+    def __init__(self, name: str, label: str, help_text: str = ""):
+        self.name, self.label, self.help = name, label, help_text
+        self._v: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, label_value: str, v: float) -> None:
+        with self._lock:
+            self._v[label_value] = float(v)
+
+    def value(self, label_value: str) -> float:
+        return self._v.get(label_value, 0.0)
+
+    def values(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._v)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = sorted(self._v.items())
+        for lv, v in items:
+            lines.append(f'{self.name}{{{self.label}="{_escape_label(lv)}"}}'
+                         f' {_fmt(v)}')
+        return "\n".join(lines) + "\n"
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus ``histogram``) with quantile
+    estimation by linear interpolation within the winning bucket —
+    enough resolution for p50/p99 gauges on the metrics page."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: Sequence[float] = _LATENCY_BUCKETS):
+        self.name, self.help = name, help_text
+        self.bounds = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, x: float) -> None:
+        i = bisect.bisect_left(self.bounds, x)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += x
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Approximate q-quantile from the bucket counts.  Edge cases
+        are exact: no observations -> 0.0; ``q<=0`` -> the lower edge of
+        the first non-empty bucket; ``q>=1`` -> the upper edge of the
+        last non-empty finite bucket (the top finite bound when the
+        overflow bucket holds observations)."""
+        with self._lock:
+            n = self._n
+            counts = list(self._counts)
+        if n == 0:
+            return 0.0
+        if q <= 0.0:
+            # lower edge of the first non-empty bucket (0.0 below the
+            # first bound) — previously this returned bounds[0] even
+            # when the first buckets were empty
+            for i, c in enumerate(counts):
+                if c > 0:
+                    return self.bounds[i - 1] if i > 0 else 0.0
+            return 0.0
+        target = min(q, 1.0) * n
+        cum = 0.0
+        for i, c in enumerate(counts):
+            prev = cum
+            cum += c
+            if cum >= target and c > 0:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else lo
+                if hi <= lo:
+                    return hi
+                return lo + (hi - lo) * (target - prev) / c
+        return self.bounds[-1]
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        cum = 0
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._n, self._sum
+        for bound, c in zip(self.bounds, counts):
+            cum += c
+            lines.append(f'{self.name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{self.name}_sum {_fmt(s)}")
+        lines.append(f"{self.name}_count {total}")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------- registry
+class MetricsRegistry:
+    """Process-wide registry of named metric GROUPS.
+
+    Groups (not individual metrics) register a render callable under a
+    stable name; re-registering a name replaces the previous group (a
+    test that builds several ``ServingMetrics`` keeps exactly one
+    registered).  :meth:`render` concatenates every group — the body of
+    the training ``/metrics`` endpoint, and the tail of the serving
+    one."""
+
+    def __init__(self):
+        self._groups: Dict[str, Callable[[], str]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, render_fn: Callable[[], str]) -> None:
+        with self._lock:
+            self._groups[name] = render_fn
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._groups.pop(name, None)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._groups)
+
+    def render(self, exclude: Sequence[str] = ()) -> str:
+        with self._lock:
+            groups = [(n, fn) for n, fn in self._groups.items()
+                      if n not in exclude]
+        return "".join(fn() for _, fn in groups)
+
+
+_REGISTRY: Optional[MetricsRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide MetricsRegistry singleton."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        with _REGISTRY_LOCK:
+            if _REGISTRY is None:
+                _REGISTRY = MetricsRegistry()
+    return _REGISTRY
+
+
+# ------------------------------------------------------------- reliability
+class ReliabilityMetrics:
+    """Process-wide failure-path accounting (RELIABILITY.md): how often
+    the crash-safety machinery actually engaged.  One instance per
+    process (:func:`reliability_metrics`), shared by the learner's
+    model I/O, the CLI checkpoint ring, and the serving stack; rendered
+    into every ``/metrics`` body via the registry."""
+
+    def __init__(self, prefix: str = "xgbtpu_reliability"):
+        p = prefix
+        self.integrity_failures = Counter(
+            f"{p}_integrity_failures_total",
+            "persisted files that failed CRC/footer verification")
+        self.ring_fallbacks = Counter(
+            f"{p}_ckpt_ring_fallbacks_total",
+            "checkpoint loads that fell back past a corrupt ring member")
+        self.quarantines = Counter(
+            f"{p}_quarantined_files_total",
+            "corrupt files moved aside as *.corrupt")
+        self.poisoned_reloads = Counter(
+            f"{p}_poisoned_reload_skips_total",
+            "reload polls skipped because the file content is known-bad")
+        self.shed_requests = Counter(
+            f"{p}_shed_requests_total",
+            "abandoned (caller timed out) requests shed before dispatch")
+        self.faults_injected = Counter(
+            f"{p}_faults_injected_total",
+            "chaos faults fired by the injection registry")
+        self.drain_seconds = Gauge(
+            f"{p}_drain_seconds",
+            "duration of the last HTTP drain (SIGTERM to stopped)")
+        self._all = (self.integrity_failures, self.ring_fallbacks,
+                     self.quarantines, self.poisoned_reloads,
+                     self.shed_requests, self.faults_injected,
+                     self.drain_seconds)
+        registry().register("reliability", self.render)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._all)
+
+
+_RELIABILITY: Optional[ReliabilityMetrics] = None
+_RELIABILITY_LOCK = threading.Lock()
+
+
+def reliability_metrics() -> ReliabilityMetrics:
+    """The process-wide ReliabilityMetrics singleton.  Counters are
+    cumulative for the process lifetime; tests read deltas."""
+    global _RELIABILITY
+    if _RELIABILITY is None:
+        with _RELIABILITY_LOCK:
+            if _RELIABILITY is None:
+                _RELIABILITY = ReliabilityMetrics()
+    return _RELIABILITY
+
+
+# ---------------------------------------------------------------- training
+class TrainingMetrics:
+    """Training-side metric group (``xgbtpu_training_*``): live progress
+    of a long run, scrapeable mid-run via the ``metrics_port=`` daemon
+    (obs/server.py).  One instance per process
+    (:func:`training_metrics`), fed by the round profiler
+    (obs/profiler.py), the eval path, and the CLI checkpoint loop."""
+
+    def __init__(self, prefix: str = "xgbtpu_training"):
+        p = prefix
+        self.rounds = Counter(
+            f"{p}_rounds_total", "boosting rounds completed")
+        self.round = Gauge(
+            f"{p}_round", "most recently completed boosting round index")
+        self.round_seconds = Histogram(
+            f"{p}_round_seconds", "wall time per boosting round",
+            _ROUND_BUCKETS)
+        self.phase_seconds = LabeledCounter(
+            f"{p}_phase_seconds_total", "phase",
+            "cumulative wall seconds per round phase "
+            "(predict/gradient/grow/eval)")
+        self.eval_score = LabeledGauge(
+            f"{p}_eval_score", "key",
+            "latest eval metric values, keyed set-metric")
+        self.checkpoints = Counter(
+            f"{p}_checkpoints_total", "model checkpoints written")
+        self.checkpoint_seconds = Counter(
+            f"{p}_checkpoint_seconds_total",
+            "cumulative wall seconds spent writing checkpoints "
+            "(the reference report_stats' checkpoint cost)")
+        self.device_memory = Gauge(
+            f"{p}_device_memory_bytes",
+            "bytes in use on local device 0 (0 when the backend does "
+            "not report memory stats)")
+        self._all = (self.rounds, self.round, self.round_seconds,
+                     self.phase_seconds, self.eval_score,
+                     self.checkpoints, self.checkpoint_seconds,
+                     self.device_memory)
+        registry().register("training", self.render)
+
+    def observe_eval(self, scores: Dict[str, float]) -> None:
+        """Record parsed eval-line scores (``{'train-error': 0.02}``)
+        as gauges."""
+        for k, v in scores.items():
+            try:
+                self.eval_score.set(k, float(v))
+            except (TypeError, ValueError):
+                pass
+
+    def refresh_device_memory(self) -> None:
+        """Best-effort device-memory gauge via
+        ``jax.local_devices()[0].memory_stats()`` (TPU/GPU report it;
+        CPU returns None — the gauge stays 0 there)."""
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                self.device_memory.set(float(stats.get("bytes_in_use", 0)))
+        except Exception:
+            pass
+
+    def render(self) -> str:
+        self.refresh_device_memory()
+        return "".join(m.render() for m in self._all)
+
+
+_TRAINING: Optional[TrainingMetrics] = None
+_TRAINING_LOCK = threading.Lock()
+
+
+def training_metrics() -> TrainingMetrics:
+    """The process-wide TrainingMetrics singleton."""
+    global _TRAINING
+    if _TRAINING is None:
+        with _TRAINING_LOCK:
+            if _TRAINING is None:
+                _TRAINING = TrainingMetrics()
+    return _TRAINING
+
+
+# ----------------------------------------------------------------- serving
+class ServingMetrics:
+    """Metric registry for the serving subsystem (see SERVING.md for the
+    full schema).  One instance is shared by engine + batcher + registry
+    + HTTP front end; :meth:`render` produces the ``GET /metrics`` body.
+    The instance registers into the process-wide registry as group
+    ``"serving"`` (latest instance wins), and its own render appends
+    every OTHER registered group, so one scrape covers steady-state,
+    failure-path, and training-side behavior at once."""
+
+    def __init__(self, prefix: str = "xgbtpu_serving"):
+        self.prefix = prefix
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        p = prefix
+        self.requests = self.counter(
+            f"{p}_requests_total", "prediction requests received")
+        self.rows = self.counter(
+            f"{p}_rows_total", "real (caller-supplied) rows predicted")
+        self.padded_rows = self.counter(
+            f"{p}_padded_rows_total",
+            "padding rows added to reach the shape bucket")
+        self.rejected = self.counter(
+            f"{p}_rejected_total", "requests rejected with QueueFull (503)")
+        self.errors = self.counter(
+            f"{p}_errors_total", "requests that raised during prediction")
+        self.batches = self.counter(
+            f"{p}_batches_total", "coalesced device batches executed")
+        self.compiles = self.counter(
+            f"{p}_compiles_total", "predict executables compiled")
+        self.reloads = self.counter(
+            f"{p}_reloads_total", "successful model hot-reloads")
+        self.reload_errors = self.counter(
+            f"{p}_reload_errors_total", "failed model reload attempts")
+        self.queue_rows = self.gauge(
+            f"{p}_queue_rows", "rows currently waiting in the batch queue")
+        self.model_version = self.gauge(
+            f"{p}_model_version", "monotonic version of the served model")
+        self.batch_rows = self.histogram(
+            f"{p}_batch_rows", "rows per coalesced device batch",
+            _ROWS_BUCKETS)
+        self.latency = self.histogram(
+            f"{p}_latency_seconds",
+            "request latency, submit to result (includes queueing)")
+        registry().register("serving", self._render_own)
+
+    # ------------------------------------------------------- constructors
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._register(Counter(name, help_text))
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._register(Gauge(name, help_text))
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Sequence[float] = _LATENCY_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_text, buckets))
+
+    def _register(self, m):
+        with self._lock:
+            if m.name in self._metrics:
+                return self._metrics[m.name]
+            self._metrics[m.name] = m
+            return m
+
+    # ------------------------------------------------------------- render
+    @property
+    def uptime_seconds(self) -> float:
+        return time.time() - self._t0
+
+    def quantiles(self, qs: Tuple[float, ...] = (0.5, 0.99)
+                  ) -> Dict[float, float]:
+        return {q: self.latency.quantile(q) for q in qs}
+
+    def _render_own(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        parts = [m.render() for m in metrics]
+        # p50/p99 latency as plain gauges (scrapers that don't do
+        # histogram_quantile still get the headline numbers)
+        for q, label in ((0.5, "p50"), (0.99, "p99")):
+            v = self.latency.quantile(q)
+            name = f"{self.prefix}_latency_{label}_seconds"
+            parts.append(f"# HELP {name} {label} request latency\n"
+                         f"# TYPE {name} gauge\n{name} {_fmt(v)}\n")
+        return "".join(parts)
+
+    def render(self) -> str:
+        # every other registered group rides along (reliability has
+        # always been here; training/comm join when active) so one
+        # scrape covers the whole process
+        reliability_metrics()  # ensure the classic tail exists
+        return self._render_own() + registry().render(exclude=("serving",))

@@ -68,7 +68,19 @@ def grow_tree_colsplit(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
         row_valid = jnp.ones(N, jnp.bool_)
     fn = _colsplit_fn(mesh, cfg, f_local, n_shard,
                       F if f_real is None else int(f_real))
-    return fn(key, binned, gh, cut_values, n_cuts, row_valid)
+    # collective accounting (obs/comm.py, the report_stats analog):
+    # each level all-gathers one SplitDecision per shard per node and
+    # psums the (N,) routing bits — count one "allgather" per level
+    # with the logical per-level payload (estimate; the launch itself
+    # is one fused XLA program, so wall time covers the whole tree)
+    from xgboost_tpu.obs import comm
+    n_nodes = (1 << cfg.max_depth) - 1
+    est_bytes = (cfg.max_depth * n_shard * 24     # SplitDecision fields
+                 + n_nodes * 24                   # per-node candidates
+                 + cfg.max_depth * N * 4)         # routing-bit psum
+    with comm.timed("allgather", nbytes=float(est_bytes),
+                    count=cfg.max_depth):
+        return fn(key, binned, gh, cut_values, n_cuts, row_valid)
 
 
 @functools.lru_cache(maxsize=64)

@@ -50,12 +50,17 @@ class FaultInjector:
         coord = (self.version, self.seqno, self.trial)
         self.seqno += 1
         if (self.version, coord[1], self.trial) in self.spec:
+            from xgboost_tpu.obs import trace
+            trace.event("fault.injected", kind="worker_death",
+                        seam="collective", seqno=coord[1],
+                        trial=self.trial)
             raise WorkerFailure(
                 f"[mock] die at version={coord[0]} seqno={coord[1]} "
                 f"trial={self.trial}")
 
 
 _injector: Optional[FaultInjector] = None
+_calls = 0  # lifetime collective-seam entries (the report_stats count)
 
 
 def set_fault_injection(spec: List[Tuple[int, int, int]],
@@ -71,14 +76,41 @@ def clear_fault_injection() -> None:
 
 
 def begin_round(version: int) -> None:
+    # the round boundary doubles as the observability round marker:
+    # collective stats (obs/comm.py) and discrete events correlate by
+    # this version, the report_stats "version" role
+    from xgboost_tpu.obs import comm, trace
+    comm.begin_round(version)
+    trace.set_round(version)
     if _injector is not None:
         _injector.begin_round(version)
 
 
-def collective() -> None:
-    """Call at every host-side collective entry (tree-growth launch)."""
+def collective(op: str = "allreduce", nbytes: float = 0.0) -> None:
+    """Call at every host-side collective entry (tree-growth launch).
+
+    Besides the fault-injection seqno, each entry is COUNTED into the
+    per-worker collective stats (``xgbtpu_comm_<op>_total`` and the
+    per-round tallies, obs/comm.py) with the caller's logical payload
+    estimate — so the exported allreduce count matches this seam's
+    seqno space by construction.  Wall seconds are added by the caller
+    timing the launch (``comm.timed(..., count=0)``)."""
+    global _calls
+    _calls += 1
+    # record BEFORE the injector can raise: a simulated worker death
+    # at this coordinate still counted an attempted collective, so
+    # xgbtpu_comm_<op>_total and collective_calls() stay equal even
+    # across fault trials
+    from xgboost_tpu.obs import comm
+    comm.record(op, nbytes=nbytes)
     if _injector is not None:
         _injector.collective()
+
+
+def collective_calls() -> int:
+    """Lifetime number of collective-seam entries in this process (the
+    number the exported ``xgbtpu_comm_allreduce_total`` must match)."""
+    return _calls
 
 
 def active() -> bool:

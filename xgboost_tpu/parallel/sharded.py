@@ -262,13 +262,21 @@ class ShardedDMatrix:
         return self.make_global(gids < self.global_num_row)
 
     # --------------------------------------------------------- collectives
+    # Every host-side collective below records (count, bytes, seconds)
+    # into the per-worker collective stats (obs/comm.py, the
+    # report_stats analog) as op "allgather" — these really are
+    # process_allgather launches, unlike the in-XLA psum reductions the
+    # growth seam accounts as "allreduce".
+
     @staticmethod
     def _allgather_i64(x: int) -> np.ndarray:
         import jax
         if jax.process_count() == 1:
             return np.asarray([x], np.int64)
         from jax.experimental import multihost_utils as mhu
-        return np.asarray(mhu.process_allgather(np.int64(x)))
+        from xgboost_tpu.obs import comm
+        with comm.timed("allgather", nbytes=8 * jax.process_count()):
+            return np.asarray(mhu.process_allgather(np.int64(x)))
 
     @staticmethod
     def allgatherv(mat: np.ndarray) -> np.ndarray:
@@ -282,12 +290,15 @@ class ShardedDMatrix:
         if jax.process_count() == 1:
             return m
         from jax.experimental import multihost_utils as mhu
+        from xgboost_tpu.obs import comm
         lens = np.asarray(mhu.process_allgather(np.int64(m.shape[0])))
         kmax = int(lens.max())
         pad = np.zeros((kmax, m.shape[1]), np.float64)
         pad[:m.shape[0]] = m
         buf = np.frombuffer(pad.tobytes(), np.uint8)
-        gathered = np.asarray(mhu.process_allgather(buf))
+        with comm.timed("allgather",
+                        nbytes=buf.nbytes * jax.process_count()):
+            gathered = np.asarray(mhu.process_allgather(buf))
         out = np.frombuffer(gathered.tobytes(), np.float64).reshape(
             jax.process_count(), kmax, m.shape[1])
         return np.concatenate(
@@ -303,8 +314,11 @@ class ShardedDMatrix:
         if jax.process_count() == 1:
             return v
         from jax.experimental import multihost_utils as mhu
+        from xgboost_tpu.obs import comm
         buf = np.frombuffer(v.tobytes(), np.uint8)
-        gathered = np.asarray(mhu.process_allgather(buf))
+        with comm.timed("allgather",
+                        nbytes=buf.nbytes * jax.process_count()):
+            gathered = np.asarray(mhu.process_allgather(buf))
         return np.frombuffer(
             gathered.tobytes(), np.float64).reshape(
                 jax.process_count(), -1).sum(axis=0)

@@ -1,403 +1,33 @@
-"""Per-round timing breakdown + jax.profiler trace capture, plus the
-Prometheus-style serving metrics (:class:`ServingMetrics`) consumed by
-``xgboost_tpu.serving``'s ``GET /metrics`` endpoint.
+"""Compatibility shim: the profiling/metrics layer moved to
+:mod:`xgboost_tpu.obs` (OBSERVABILITY.md).
 
-The analog of the reference's ``report_stats`` accounting
-(``subtree/rabit/src/allreduce_mock.h:52-56,87-95``: per-version
-allreduce time and checkpoint cost) and of SURVEY.md §5.1's "keep the
-report_stats idea".  Two levels:
-
-- ``profile=1`` — host-side phase timing per boosting round (predict /
-  gradient / grow / eval), printed per round and summarized at the end.
-  Phases force a true device barrier at their boundaries so async
-  dispatch doesn't smear costs across phases.  On remote-attached
-  backends (tunnels) a barrier costs a full round-trip, so per-phase
-  numbers are inflated by that constant — see PROFILE.md; off by
-  default.
-- ``profile=2`` — additionally captures a ``jax.profiler`` trace into
-  ``profile_dir`` (default ``./xgtpu_profile``) for XProf/TensorBoard —
-  the device-side view of kernel time.
+Everything that used to live here — :class:`RoundProfiler` (``profile=1/2``
+per-round phase timing), the Prometheus-style primitives
+(:class:`Counter`/:class:`Gauge`/:class:`Histogram`) and the
+:class:`ServingMetrics`/:class:`ReliabilityMetrics` groups — is
+re-exported unchanged, so ``from xgboost_tpu.profiling import ...``
+keeps working.  New code should import from ``xgboost_tpu.obs``
+directly, which also carries the pieces that never existed here:
+tracing spans, the structured event log, :class:`TrainingMetrics`, the
+``metrics_port=`` scrape server, and per-worker collective stats.
 """
 
 from __future__ import annotations
 
-import bisect
-import threading
-import time
-from collections import defaultdict
-from typing import Dict, Optional, Sequence, Tuple
+from xgboost_tpu.obs.metrics import (_LATENCY_BUCKETS,  # noqa: F401
+                                     _ROWS_BUCKETS, Counter, Gauge,
+                                     Histogram, LabeledCounter,
+                                     LabeledGauge, MetricsRegistry,
+                                     ReliabilityMetrics, ServingMetrics,
+                                     TrainingMetrics, _fmt, registry,
+                                     reliability_metrics,
+                                     training_metrics)
+from xgboost_tpu.obs.profiler import RoundProfiler, _Phase  # noqa: F401
 
-
-class RoundProfiler:
-    """Collects per-phase wall time per boosting round."""
-
-    def __init__(self, level: int = 1, trace_dir: Optional[str] = None,
-                 out=None):
-        import sys
-        self.level = level
-        self.trace_dir = trace_dir or "./xgtpu_profile"
-        self.out = out if out is not None else sys.stderr
-        self.rounds = []
-        self._current = None
-        self._tracing = False
-
-    # ------------------------------------------------------------ lifecycle
-    def start(self):
-        if self.level >= 2 and not self._tracing:
-            import jax
-            jax.profiler.start_trace(self.trace_dir)
-            self._tracing = True
-
-    def stop(self):
-        if self._tracing:
-            import jax
-            jax.profiler.stop_trace()
-            self._tracing = False
-            print(f"[prof] jax.profiler trace written to {self.trace_dir}",
-                  file=self.out)
-
-    # ---------------------------------------------------------- round phases
-    def begin_round(self, iteration: int):
-        self._current = {"round": iteration, "phases": {}, "t0": None}
-
-    def phase(self, name: str):
-        """Context manager timing one phase of the current round.  Call
-        ``.block(x)`` inside (or rely on the caller's own sync) to pin
-        async device work to this phase."""
-        return _Phase(self, name)
-
-    def end_round(self):
-        if self._current is None:
-            return
-        c = self._current
-        total = sum(c["phases"].values())
-        parts = " ".join(f"{k}={v * 1e3:.1f}ms"
-                         for k, v in c["phases"].items())
-        print(f"[prof] round {c['round']}: total={total * 1e3:.1f}ms "
-              f"{parts}", file=self.out)
-        self.rounds.append(c)
-        self._current = None
-
-    # ------------------------------------------------------------- summary
-    def summary(self) -> str:
-        if not self.rounds:
-            return "[prof] no rounds recorded"
-        agg = defaultdict(float)
-        for r in self.rounds:
-            for k, v in r["phases"].items():
-                agg[k] += v
-        total = sum(agg.values())
-        n = len(self.rounds)
-        lines = [f"[prof] {n} rounds, {total:.3f}s total, "
-                 f"{total / n * 1e3:.1f}ms/round"]
-        for k, v in sorted(agg.items(), key=lambda kv: -kv[1]):
-            lines.append(f"[prof]   {k:<10s} {v:8.3f}s  "
-                         f"{v / total * 100:5.1f}%  {v / n * 1e3:8.1f}ms/round")
-        return "\n".join(lines)
-
-    def print_summary(self):
-        print(self.summary(), file=self.out)
-
-
-class _Phase:
-    def __init__(self, prof: RoundProfiler, name: str):
-        self.prof = prof
-        self.name = name
-        self._blocked = None
-
-    def block(self, x):
-        """Record device arrays whose completion closes this phase."""
-        self._blocked = x
-        return x
-
-    def __enter__(self):
-        self.t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        if self._blocked is not None and exc[0] is None:
-            import jax
-            jax.block_until_ready(self._blocked)
-            # block_until_ready is advisory on some remote-attached
-            # backends (axon tunnel); one single-element host pull is a
-            # true barrier on the in-order stream (last leaf suffices)
-            leaves = [x for x in jax.tree.leaves(self._blocked)
-                      if hasattr(x, "ravel")
-                      and getattr(x, "is_fully_addressable", True)]
-            if leaves:
-                jax.device_get(leaves[-1].ravel()[:1])
-        cur = self.prof._current
-        if cur is None and self.prof.rounds:
-            # outside begin/end (e.g. eval after end_round): fold into
-            # the most recent round
-            cur = self.prof.rounds[-1]
-        if cur is not None:
-            cur["phases"][self.name] = (
-                cur["phases"].get(self.name, 0.0)
-                + time.perf_counter() - self.t0)
-        return False
-
-
-# --------------------------------------------------------------- serving
-# Prometheus-style metric primitives for the serving subsystem.  These
-# follow the RoundProfiler conventions — named per-phase accounting,
-# render() as the print_summary analog — but expose the text exposition
-# format a scraper expects instead of stderr lines.
-
-# latency buckets in seconds: 0.5ms .. 5s, roughly x2 per step
-_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
-                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
-# batch-size buckets in rows: powers of two
-_ROWS_BUCKETS = tuple(float(1 << i) for i in range(15))
-
-
-class Counter:
-    """Monotonic counter (Prometheus ``counter``)."""
-
-    def __init__(self, name: str, help_text: str = ""):
-        self.name, self.help = name, help_text
-        self._v = 0.0
-        self._lock = threading.Lock()
-
-    def inc(self, v: float = 1.0) -> None:
-        with self._lock:
-            self._v += v
-
-    @property
-    def value(self) -> float:
-        return self._v
-
-    def render(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} counter\n"
-                f"{self.name} {_fmt(self._v)}\n")
-
-
-class Gauge:
-    """Settable value (Prometheus ``gauge``)."""
-
-    def __init__(self, name: str, help_text: str = ""):
-        self.name, self.help = name, help_text
-        self._v = 0.0
-        self._lock = threading.Lock()
-
-    def set(self, v: float) -> None:
-        with self._lock:
-            self._v = float(v)
-
-    def inc(self, v: float = 1.0) -> None:
-        with self._lock:
-            self._v += v
-
-    @property
-    def value(self) -> float:
-        return self._v
-
-    def render(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} gauge\n"
-                f"{self.name} {_fmt(self._v)}\n")
-
-
-class Histogram:
-    """Fixed-bucket histogram (Prometheus ``histogram``) with quantile
-    estimation by linear interpolation within the winning bucket —
-    enough resolution for p50/p99 gauges on the metrics page."""
-
-    def __init__(self, name: str, help_text: str = "",
-                 buckets: Sequence[float] = _LATENCY_BUCKETS):
-        self.name, self.help = name, help_text
-        self.bounds = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
-        self._sum = 0.0
-        self._n = 0
-        self._lock = threading.Lock()
-
-    def observe(self, x: float) -> None:
-        i = bisect.bisect_left(self.bounds, x)
-        with self._lock:
-            self._counts[i] += 1
-            self._sum += x
-            self._n += 1
-
-    @property
-    def count(self) -> int:
-        return self._n
-
-    @property
-    def sum(self) -> float:
-        return self._sum
-
-    def quantile(self, q: float) -> float:
-        """Approximate q-quantile (0..1) from the bucket counts."""
-        with self._lock:
-            n = self._n
-            counts = list(self._counts)
-        if n == 0:
-            return 0.0
-        target = q * n
-        cum = 0.0
-        for i, c in enumerate(counts):
-            prev = cum
-            cum += c
-            if cum >= target:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i] if i < len(self.bounds) else lo
-                if c == 0 or hi <= lo:
-                    return hi
-                return lo + (hi - lo) * (target - prev) / c
-        return self.bounds[-1]
-
-    def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} histogram"]
-        cum = 0
-        with self._lock:
-            counts = list(self._counts)
-            total, s = self._n, self._sum
-        for bound, c in zip(self.bounds, counts):
-            cum += c
-            lines.append(f'{self.name}_bucket{{le="{_fmt(bound)}"}} {cum}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{self.name}_sum {_fmt(s)}")
-        lines.append(f"{self.name}_count {total}")
-        return "\n".join(lines) + "\n"
-
-
-def _fmt(v: float) -> str:
-    return f"{int(v)}" if float(v).is_integer() else repr(float(v))
-
-
-class ReliabilityMetrics:
-    """Process-wide failure-path accounting (RELIABILITY.md): how often
-    the crash-safety machinery actually engaged.  One instance per
-    process (:func:`reliability_metrics`), shared by the learner's
-    model I/O, the CLI checkpoint ring, and the serving stack; rendered
-    into the serving ``GET /metrics`` body alongside ServingMetrics."""
-
-    def __init__(self, prefix: str = "xgbtpu_reliability"):
-        p = prefix
-        self.integrity_failures = Counter(
-            f"{p}_integrity_failures_total",
-            "persisted files that failed CRC/footer verification")
-        self.ring_fallbacks = Counter(
-            f"{p}_ckpt_ring_fallbacks_total",
-            "checkpoint loads that fell back past a corrupt ring member")
-        self.quarantines = Counter(
-            f"{p}_quarantined_files_total",
-            "corrupt files moved aside as *.corrupt")
-        self.poisoned_reloads = Counter(
-            f"{p}_poisoned_reload_skips_total",
-            "reload polls skipped because the file content is known-bad")
-        self.shed_requests = Counter(
-            f"{p}_shed_requests_total",
-            "abandoned (caller timed out) requests shed before dispatch")
-        self.faults_injected = Counter(
-            f"{p}_faults_injected_total",
-            "chaos faults fired by the injection registry")
-        self.drain_seconds = Gauge(
-            f"{p}_drain_seconds",
-            "duration of the last HTTP drain (SIGTERM to stopped)")
-        self._all = (self.integrity_failures, self.ring_fallbacks,
-                     self.quarantines, self.poisoned_reloads,
-                     self.shed_requests, self.faults_injected,
-                     self.drain_seconds)
-
-    def render(self) -> str:
-        return "".join(m.render() for m in self._all)
-
-
-_RELIABILITY: Optional[ReliabilityMetrics] = None
-_RELIABILITY_LOCK = threading.Lock()
-
-
-def reliability_metrics() -> ReliabilityMetrics:
-    """The process-wide ReliabilityMetrics singleton.  Counters are
-    cumulative for the process lifetime; tests read deltas."""
-    global _RELIABILITY
-    if _RELIABILITY is None:
-        with _RELIABILITY_LOCK:
-            if _RELIABILITY is None:
-                _RELIABILITY = ReliabilityMetrics()
-    return _RELIABILITY
-
-
-class ServingMetrics:
-    """Metric registry for the serving subsystem (see SERVING.md for the
-    full schema).  One instance is shared by engine + batcher + registry
-    + HTTP front end; :meth:`render` produces the ``GET /metrics`` body.
-    """
-
-    def __init__(self, prefix: str = "xgbtpu_serving"):
-        self.prefix = prefix
-        self._metrics: Dict[str, object] = {}
-        self._lock = threading.Lock()
-        p = prefix
-        self.requests = self.counter(
-            f"{p}_requests_total", "prediction requests received")
-        self.rows = self.counter(
-            f"{p}_rows_total", "real (caller-supplied) rows predicted")
-        self.padded_rows = self.counter(
-            f"{p}_padded_rows_total",
-            "padding rows added to reach the shape bucket")
-        self.rejected = self.counter(
-            f"{p}_rejected_total", "requests rejected with QueueFull (503)")
-        self.errors = self.counter(
-            f"{p}_errors_total", "requests that raised during prediction")
-        self.batches = self.counter(
-            f"{p}_batches_total", "coalesced device batches executed")
-        self.compiles = self.counter(
-            f"{p}_compiles_total", "predict executables compiled")
-        self.reloads = self.counter(
-            f"{p}_reloads_total", "successful model hot-reloads")
-        self.reload_errors = self.counter(
-            f"{p}_reload_errors_total", "failed model reload attempts")
-        self.queue_rows = self.gauge(
-            f"{p}_queue_rows", "rows currently waiting in the batch queue")
-        self.model_version = self.gauge(
-            f"{p}_model_version", "monotonic version of the served model")
-        self.batch_rows = self.histogram(
-            f"{p}_batch_rows", "rows per coalesced device batch",
-            _ROWS_BUCKETS)
-        self.latency = self.histogram(
-            f"{p}_latency_seconds",
-            "request latency, submit to result (includes queueing)")
-
-    # ------------------------------------------------------- constructors
-    def counter(self, name: str, help_text: str = "") -> Counter:
-        return self._register(Counter(name, help_text))
-
-    def gauge(self, name: str, help_text: str = "") -> Gauge:
-        return self._register(Gauge(name, help_text))
-
-    def histogram(self, name: str, help_text: str = "",
-                  buckets: Sequence[float] = _LATENCY_BUCKETS) -> Histogram:
-        return self._register(Histogram(name, help_text, buckets))
-
-    def _register(self, m):
-        with self._lock:
-            if m.name in self._metrics:
-                return self._metrics[m.name]
-            self._metrics[m.name] = m
-            return m
-
-    # ------------------------------------------------------------- render
-    def quantiles(self, qs: Tuple[float, ...] = (0.5, 0.99)
-                  ) -> Dict[float, float]:
-        return {q: self.latency.quantile(q) for q in qs}
-
-    def render(self) -> str:
-        with self._lock:
-            metrics = list(self._metrics.values())
-        parts = [m.render() for m in metrics]
-        # p50/p99 latency as plain gauges (scrapers that don't do
-        # histogram_quantile still get the headline numbers)
-        for q, label in ((0.5, "p50"), (0.99, "p99")):
-            v = self.latency.quantile(q)
-            name = f"{self.prefix}_latency_{label}_seconds"
-            parts.append(f"# HELP {name} {label} request latency\n"
-                         f"# TYPE {name} gauge\n{name} {_fmt(v)}\n")
-        # the process-wide reliability counters ride along so one scrape
-        # covers both steady-state and failure-path behavior
-        parts.append(reliability_metrics().render())
-        return "".join(parts)
+__all__ = [
+    "RoundProfiler",
+    "Counter", "Gauge", "Histogram", "LabeledCounter", "LabeledGauge",
+    "MetricsRegistry", "registry",
+    "ServingMetrics", "ReliabilityMetrics", "TrainingMetrics",
+    "reliability_metrics", "training_metrics",
+]

@@ -134,7 +134,7 @@ def install_spec(spec: str) -> None:
         inject(part.strip(), arg, path_sub or None, times)
 
 
-def _take(kinds, path: Optional[str]) -> List[_Fault]:
+def _take(kinds, path: Optional[str], seam: str = "") -> List[_Fault]:
     """Pop (decrement) every armed fault of the given kinds matching
     ``path``, in arm order."""
     out = []
@@ -145,8 +145,17 @@ def _take(kinds, path: Optional[str]) -> List[_Fault]:
                 _fired[f.kind] = _fired.get(f.kind, 0) + 1
                 out.append(f)
     if out:
+        from xgboost_tpu.obs import event
         from xgboost_tpu.profiling import reliability_metrics
         reliability_metrics().faults_injected.inc(len(out))
+        for f in out:
+            # each fired fault lands in the event-log timeline (fault
+            # name, seam, path; the current boosting round attaches
+            # automatically) so a CHAOS.json run correlates its deaths
+            # and corruptions with the rounds they hit (post-mortems
+            # read the rendered tools/obs_report.py view)
+            event("fault.injected", kind=f.kind,
+                  seam=seam or f.kind, path=str(path) if path else None)
     return out
 
 
@@ -164,7 +173,7 @@ def mutate_write(path: str, data: bytes) -> bytes:
     """Write seam: called by ``integrity.atomic_write`` with the bytes
     about to be persisted.  May truncate (torn_write), corrupt
     (bit_flip), or raise ``OSError(ENOSPC)``."""
-    for f in _take(_WRITE_KINDS, path):
+    for f in _take(_WRITE_KINDS, path, seam="write"):
         if f.kind == "enospc":
             import errno
             raise OSError(errno.ENOSPC,
@@ -181,7 +190,7 @@ def mutate_write(path: str, data: bytes) -> bytes:
 def mutate_read(path: str, data: bytes) -> bytes:
     """Read seam: called by ``integrity.read_file`` with the bytes just
     read.  May delay (slow_read) or corrupt (read_flip)."""
-    for f in _take(_READ_KINDS, path):
+    for f in _take(_READ_KINDS, path, seam="read"):
         if f.kind == "slow_read":
             time.sleep(float(f.arg if f.arg is not None else 0.05))
         elif f.kind == "read_flip":
@@ -193,7 +202,7 @@ def mutate_read(path: str, data: bytes) -> bytes:
 def check(point: str, path: Optional[str] = None) -> None:
     """Named-point seam (currently ``reload``: the registry's engine
     rebuild).  Raises :class:`InjectedFault` when armed."""
-    if _take((point,), path):
+    if _take((point,), path, seam=point):
         raise InjectedFault(point, str(path) if path else "")
 
 

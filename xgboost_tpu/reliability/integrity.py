@@ -84,7 +84,7 @@ def verify_model_bytes(raw: bytes, name: str = "<buffer>",
         torn_prefix = any(raw.endswith(head[:k])
                           for k in range(2, len(head)))
         if torn_prefix or FOOTER_MAGIC in raw[-(FOOTER_LEN + 8):]:
-            _count_integrity_failure()
+            _count_integrity_failure(name, "truncated footer (torn write)")
             raise ModelIntegrityError(
                 f"{name}: truncated integrity footer (torn write)")
         if warn and name not in _warned_unverified:
@@ -96,22 +96,25 @@ def verify_model_bytes(raw: bytes, name: str = "<buffer>",
     payload = raw[:-FOOTER_LEN]
     want_crc, want_len = int(m.group(1), 16), int(m.group(2))
     if len(payload) != want_len:
-        _count_integrity_failure()
+        _count_integrity_failure(name, "length mismatch (torn write)")
         raise ModelIntegrityError(
             f"{name}: payload is {len(payload)} bytes, footer says "
             f"{want_len} (torn write)")
     got_crc = zlib.crc32(payload) & 0xFFFFFFFF
     if got_crc != want_crc:
-        _count_integrity_failure()
+        _count_integrity_failure(name, "CRC32 mismatch")
         raise ModelIntegrityError(
             f"{name}: CRC32 mismatch (footer {want_crc:08x}, content "
             f"{got_crc:08x}) — bit flip or partial overwrite")
     return payload
 
 
-def _count_integrity_failure() -> None:
+def _count_integrity_failure(name: str = "<buffer>",
+                             reason: str = "") -> None:
+    from xgboost_tpu.obs import event
     from xgboost_tpu.profiling import reliability_metrics
     reliability_metrics().integrity_failures.inc()
+    event("integrity.failure", file=name, reason=reason)
 
 
 def atomic_write(path: Union[str, os.PathLike], data: bytes,
@@ -174,6 +177,8 @@ def quarantine(path: Union[str, os.PathLike]) -> str:
         dest = f"{path}.corrupt{i}"
         i += 1
     os.replace(path, dest)
+    from xgboost_tpu.obs import event
     from xgboost_tpu.profiling import reliability_metrics
     reliability_metrics().quarantines.inc()
+    event("integrity.quarantine", file=path, quarantined_as=dest)
     return dest

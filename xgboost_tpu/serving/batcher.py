@@ -34,7 +34,7 @@ class QueueFull(RuntimeError):
 
 class _Request:
     __slots__ = ("X", "output_margin", "done", "result", "error", "t0",
-                 "abandoned")
+                 "abandoned", "trace_id")
 
     def __init__(self, X: np.ndarray, output_margin: bool):
         self.X = X
@@ -47,6 +47,11 @@ class _Request:
         # is gone, so the worker sheds the request instead of paying
         # device dispatch for a result nobody will read
         self.abandoned = False
+        # the submitter's ambient trace id (e.g. the HTTP X-Request-Id):
+        # crosses the queue so the worker's batch span can name the
+        # requests it coalesced (OBSERVABILITY.md)
+        from xgboost_tpu.obs import current_trace_id
+        self.trace_id = current_trace_id()
 
 
 class MicroBatcher:
@@ -62,7 +67,7 @@ class MicroBatcher:
       max_wait_ms: how long the first request of a batch waits for
         company before the batch launches anyway.
       max_queue_rows: bound on rows waiting in the queue (backpressure).
-      metrics: optional :class:`xgboost_tpu.profiling.ServingMetrics`.
+      metrics: optional :class:`xgboost_tpu.obs.ServingMetrics`.
     """
 
     def __init__(self, predict_fn: Callable, max_batch_rows: int = 1024,
@@ -193,10 +198,18 @@ class MicroBatcher:
         if self.metrics is not None:
             self.metrics.batches.inc()
             self.metrics.batch_rows.observe(rows)
+        from xgboost_tpu.obs import span
         try:
-            X = (live[0].X if len(live) == 1
-                 else np.concatenate([r.X for r in live], axis=0))
-            out = self.predict_fn(X, output_margin=live[0].output_margin)
+            # one span per coalesced device batch, naming the traces it
+            # carries — the link between a request's serve.request span
+            # and the batch that actually ran it
+            with span("serve.batch", rows=rows, requests=len(live),
+                      request_ids=[r.trace_id for r in live
+                                   if r.trace_id is not None][:32]):
+                X = (live[0].X if len(live) == 1
+                     else np.concatenate([r.X for r in live], axis=0))
+                out = self.predict_fn(X,
+                                      output_margin=live[0].output_margin)
             off = 0
             for r in live:
                 n = r.X.shape[0]

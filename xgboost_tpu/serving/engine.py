@@ -57,7 +57,7 @@ class PredictEngine:
         the top bucket are chunked through it.
       warmup: pre-compile (and execute once) every bucket at
         construction so the first real request already hits the cache.
-      metrics: optional :class:`xgboost_tpu.profiling.ServingMetrics`.
+      metrics: optional :class:`xgboost_tpu.obs.ServingMetrics`.
     """
 
     def __init__(self, model, buckets: Optional[Sequence[int]] = None,
@@ -216,9 +216,14 @@ class PredictEngine:
         if self.metrics is not None:
             self.metrics.rows.inc(n)
             self.metrics.padded_rows.inc(bucket - n)
-        margin = self._executable(bucket)(
-            self._stack, self._group, self._jnp.asarray(binned),
-            self._base_for(bucket))
+        # the innermost serving span: the device margin computation,
+        # nested under serve.batch -> serve.request when the event log
+        # is on (a no-op otherwise)
+        from xgboost_tpu.obs import span
+        with span("serve.predict", rows=n, bucket=bucket):
+            margin = self._executable(bucket)(
+                self._stack, self._group, self._jnp.asarray(binned),
+                self._base_for(bucket))
         # the transform runs OUTSIDE the compiled margin executable, via
         # the objective's own (row-independent) ops — the exact functions
         # Learner.predict dispatches, so rounding matches bit for bit

@@ -41,6 +41,8 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from xgboost_tpu.obs import span, trace, trace_context
+from xgboost_tpu.obs.server import PROM_CONTENT_TYPE
 from xgboost_tpu.serving.batcher import MicroBatcher, QueueFull
 from xgboost_tpu.serving.registry import ModelRegistry
 
@@ -111,6 +113,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        rid = getattr(self, "_request_id", None)
+        if rid is not None:
+            # the id that correlates this response with its span in the
+            # event log (and with the client's own tracing)
+            self.send_header("X-Request-Id", rid)
         self.end_headers()
         self.wfile.write(body)
 
@@ -119,6 +126,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---------------------------------------------------------------- GET
     def do_GET(self):
+        # handler instances persist across a keep-alive connection:
+        # a request id set by an earlier /predict must not leak onto
+        # this response
+        self._request_id = None
         url = urlparse(self.path)
         if url.path == "/healthz":
             reg: ModelRegistry = self.server.registry
@@ -132,6 +143,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "status": "degraded" if reg.poisoned else "ok",
                 "state": ps.state,
                 "model_version": reg.version,
+                "uptime_seconds": round(time.time() - ps.t0, 3),
                 "queue_rows": self.server.batcher.queued_rows,
                 "inflight": ps.inflight,
                 "buckets_compiled": reg.engine.num_compiled,
@@ -142,13 +154,16 @@ class _Handler(BaseHTTPRequestHandler):
             })
             return
         if url.path == "/metrics":
+            # the full Prometheus exposition content type (scrapers key
+            # the text-format parser off version=0.0.4 + charset)
             self._send(200, self.server.metrics.render().encode(),
-                       "text/plain; version=0.0.4")
+                       PROM_CONTENT_TYPE)
             return
         self._send_json(404, {"error": f"no route {url.path}"})
 
     # --------------------------------------------------------------- POST
     def do_POST(self):
+        self._request_id = None  # no leak across keep-alive requests
         url = urlparse(self.path)
         # ALWAYS drain the body: under HTTP/1.1 keep-alive, unread body
         # bytes would be parsed as the next request line on the reused
@@ -200,6 +215,13 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(404, {"error": f"no route {url.path}"})
 
     def _predict(self, url, body: str) -> None:
+        # request tracing (OBSERVABILITY.md): the caller's X-Request-Id
+        # (or a generated one) becomes the trace id for every span this
+        # request produces, and is echoed on the response — including
+        # the 503/400/500 branches — so client logs, server timeline
+        # and response headers all correlate on one id
+        rid = self.headers.get("X-Request-Id") or trace.new_id()
+        self._request_id = rid
         ps: PredictServer = self.server.pserver
         if not ps.enter_request():
             # draining: load balancers read the 503 as "instance going
@@ -209,11 +231,16 @@ class _Handler(BaseHTTPRequestHandler):
                                   "state": ps.state})
             return
         try:
-            self._predict_admitted(url, body)
+            with trace_context(rid):
+                with span("serve.request", request_id=rid) as sp:
+                    self._predict_admitted(url, body, sp)
         finally:
             ps.exit_request()
 
-    def _predict_admitted(self, url, body: str) -> None:
+    def _predict_admitted(self, url, body: str, sp=None) -> None:
+        def _st(code: int) -> None:
+            if sp is not None:
+                sp.set("status", code)
         try:
             qs = parse_qs(url.query)
             fmt = qs.get("format", [None])[0]
@@ -227,31 +254,42 @@ class _Handler(BaseHTTPRequestHandler):
             elif fmt == "csv":
                 X = parse_csv_rows(body)
             else:
+                _st(400)
                 self._send_json(400, {"error": f"unknown format {fmt!r}"})
                 return
             if X.shape[0] == 0:
+                _st(400)
                 self._send_json(400, {"error": "no rows in request body"})
                 return
         except Exception as e:
+            _st(400)
             self._send_json(400, {"error": f"bad request: {e}"})
             return
+        if sp is not None:
+            sp.set("rows", int(X.shape[0]))
         try:
             preds = self.server.batcher.submit(X, output_margin=output_margin)
         except QueueFull as e:
+            _st(503)
             self._send_json(503, {"error": str(e)})
             return
         except ValueError as e:
             # deterministic client-input errors surfaced by the engine
             # (e.g. more columns than model features) are 400s, not
             # server faults — keeps 5xx alerting honest
+            _st(400)
             self._send_json(400, {"error": str(e)})
             return
         except Exception as e:
+            _st(500)
             self._send_json(500, {"error": str(e)})
             return
         # the version that actually PRODUCED these predictions (tagged
         # by the registry; reg.version may have moved during a reload)
         version = getattr(preds, "model_version", reg.version)
+        _st(200)
+        if sp is not None:
+            sp.set("model_version", int(version))
         self._send_json(200, {"predictions": np.asarray(preds).tolist(),
                               "model_version": version,
                               "rows": int(X.shape[0])})
@@ -280,6 +318,7 @@ class PredictServer:
         self.metrics = metrics
         self.drain_grace = float(drain_grace)
         self.max_body_bytes = int(max_body_mb * (1 << 20))
+        self.t0 = time.time()           # /healthz uptime_seconds
         self.state = "serving"          # serving -> draining -> stopped
         self._inflight = 0
         self._inflight_cv = threading.Condition()
@@ -349,6 +388,9 @@ class PredictServer:
         self.shutdown()
         dur = time.perf_counter() - t0
         reliability_metrics().drain_seconds.set(dur)
+        from xgboost_tpu.obs import event
+        event("serving.drain", grace=grace, duration_s=round(dur, 3),
+              stragglers=self._inflight)
         return dur
 
     def _handle_sigterm(self, signum, frame) -> None:

@@ -35,6 +35,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from xgboost_tpu.obs import event, span
 from xgboost_tpu.reliability import faults
 from xgboost_tpu.reliability.integrity import read_file, verify_model_bytes
 from xgboost_tpu.serving.engine import PredictEngine
@@ -203,13 +204,16 @@ class ModelRegistry:
                 self._count_poisoned_skip()
                 return False
             try:
-                engine = self._build_engine(raw)
+                with span("serving.reload_build", path=self.path):
+                    engine = self._build_engine(raw)
             except Exception as e:
                 self.reload_failures += 1
                 self.last_reload_error = f"{type(e).__name__}: {e}"
                 self._poisoned = fp
                 if self.metrics is not None:
                     self.metrics.reload_errors.inc()
+                event("serving.reload_failed", path=self.path,
+                      error=self.last_reload_error)
                 print(f"[serving] reload failed, keeping v{self.version} "
                       f"(file poisoned until it changes): {e}",
                       file=sys.stderr)
@@ -224,6 +228,7 @@ class ModelRegistry:
             if self.metrics is not None:
                 self.metrics.reloads.inc()
                 self.metrics.model_version.set(v)
+            event("serving.reload", path=self.path, model_version=v)
             return True
 
     @staticmethod
@@ -258,6 +263,8 @@ class ModelRegistry:
             v = self.version
         if self.metrics is not None:
             self.metrics.model_version.set(v)
+        event("serving.rollback", to_engine_of=old_version,
+              model_version=v)
         print(f"[serving] rolled back to engine of v{old_version} "
               f"(now v{v})", file=sys.stderr)
         return True
