@@ -36,6 +36,27 @@ def mesh8():
     return data_parallel_mesh(8)
 
 
+@pytest.fixture
+def recompile_guard():
+    """XLA backend-compile budget assertions (ANALYSIS.md): the
+    generalized form of the serving zero-steady-state-recompile test —
+    ``with recompile_guard.expect(0): hot_path()`` fails if the region
+    compiles anything."""
+    from xgboost_tpu.analysis.runtime import RecompileGuard
+    return RecompileGuard()
+
+
+@pytest.fixture
+def lock_race_checker():
+    """Instrumented-lock race observer (ANALYSIS.md): ``instrument`` an
+    object under concurrency stress, then ``assert_clean()``.  Teardown
+    asserts automatically so a test cannot forget to look."""
+    from xgboost_tpu.analysis.runtime import LockRaceChecker
+    checker = LockRaceChecker()
+    yield checker
+    checker.assert_clean()
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     """Free compiled executables between test MODULES: a single pytest

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""xgtpu-lint CLI — thin wrapper over ``python -m xgboost_tpu.analysis``.
+
+Usage:
+    tools/xgtpu_lint.py [paths...] [--json] [--rules XGT003,XGT006]
+                        [--baseline PATH | --no-baseline]
+                        [--write-baseline] [--list-rules] [-v]
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.  Rule catalog
+and fix recipes: ANALYSIS.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from xgboost_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

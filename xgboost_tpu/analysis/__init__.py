@@ -1,0 +1,26 @@
+"""xgboost_tpu.analysis — xgtpu-lint, the project-specific correctness
+tooling (ANALYSIS.md).
+
+Static half: an AST lint engine with rules tuned to this codebase's
+hazards — recompile traps (XGT001), host<->device sync in hot loops
+(XGT002), non-atomic persistence (XGT003), swallowed exceptions
+(XGT004), lock discipline (XGT005), wall-clock durations (XGT006), and
+collectives under rank-dependent control flow (XGT007).  Run it with
+``python -m xgboost_tpu.analysis`` or ``tools/xgtpu_lint.py``; tier-1
+enforces a clean tree via ``tests/test_analysis.py``.
+
+Dynamic half (:mod:`~xgboost_tpu.analysis.runtime`): the
+``RecompileGuard`` (XLA backend-compile counting, the generalized
+serving zero-steady-state-compile assertion) and the
+``LockRaceChecker`` (instrumented locks that flag guarded-attribute
+writes without the lock and lock-order inversions), both exposed as
+pytest fixtures in ``tests/conftest.py``.
+"""
+
+from xgboost_tpu.analysis.core import (Baseline, Finding,  # noqa: F401
+                                       Result, analyze_source,
+                                       default_baseline_path, run)
+from xgboost_tpu.analysis.rules import all_rules, rules_by_code  # noqa: F401
+
+__all__ = ["Baseline", "Finding", "Result", "analyze_source", "run",
+           "default_baseline_path", "all_rules", "rules_by_code"]

@@ -1,0 +1,125 @@
+"""CLI entry point: ``python -m xgboost_tpu.analysis [paths...]``.
+
+Exit-code contract (what CI keys off):
+
+  0  clean (no unsuppressed, non-baselined findings)
+  1  findings
+  2  usage / internal error
+
+``tools/xgtpu_lint.py`` is a thin wrapper around this module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from xgboost_tpu.analysis import core
+from xgboost_tpu.analysis.rules import all_rules, rules_by_code
+
+
+def _default_paths() -> List[str]:
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m xgboost_tpu.analysis",
+        description="xgtpu-lint: JAX-aware static analysis for the "
+                    "xgboost_tpu tree (rule catalog: ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint "
+                         "(default: the xgboost_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--rules", default=None, metavar="XGT00x[,..]",
+                    help="run only the named rules")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="baseline file (default: ANALYSIS_BASELINE.json "
+                         "at the repo root, when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline (report full debt)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current finding into the "
+                         "baseline file and exit 0")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print baselined findings")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 2
+
+    try:
+        rules = (rules_by_code(args.rules.split(","))
+                 if args.rules else all_rules())
+    except ValueError as e:
+        print(f"xgtpu-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.list_rules:
+        for r in rules:
+            doc = (r.__class__.__doc__ or "").strip().splitlines()[0]
+            print(f"{r.code}  {r.name:<28s} {doc}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"xgtpu-lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or core.default_baseline_path()
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        if os.path.exists(baseline_path):
+            try:
+                baseline = core.Baseline.load(baseline_path)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"xgtpu-lint: bad baseline {baseline_path}: {e}",
+                      file=sys.stderr)
+                return 2
+        elif args.baseline:
+            print(f"xgtpu-lint: baseline not found: {baseline_path}",
+                  file=sys.stderr)
+            return 2
+
+    result = core.run(paths, baseline=baseline, rules=rules)
+
+    if args.write_baseline:
+        if args.rules:
+            print("xgtpu-lint: --write-baseline cannot be combined with "
+                  "--rules (a partial-rule scan would drop every other "
+                  "rule's accepted debt from the baseline)",
+                  file=sys.stderr)
+            return 2
+        # merge, don't clobber: entries outside the scanned paths are
+        # kept, so a subdirectory scan cannot erase the rest of the
+        # accepted-debt ledger
+        try:
+            old = (core.Baseline.load(baseline_path)
+                   if os.path.exists(baseline_path) else core.Baseline())
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"xgtpu-lint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        merged = old.rescoped(result.findings, paths)
+        merged.dump(baseline_path)
+        print(f"xgtpu-lint: accepted {len(result.findings)} finding(s) "
+              f"for the scanned paths ({sum(merged.counts.values())} "
+              f"total baselined) -> {baseline_path}", file=sys.stderr)
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        core.render_report(result, verbose=args.verbose)
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

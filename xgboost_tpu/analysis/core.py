@@ -1,0 +1,425 @@
+"""xgtpu-lint core: findings, suppressions, baseline, and the runner.
+
+The engine is deliberately dependency-free (stdlib ``ast`` only — no
+jax import), so ``python -m xgboost_tpu.analysis`` runs anywhere the
+source tree exists, including CI hosts with no accelerator runtime.
+
+Three layers of "this finding is accepted":
+
+1. **inline suppression** — ``# xgtpu: disable=XGT003`` on the
+   offending line (or on a comment line directly above it) silences the
+   named rule(s) for that statement; ``# xgtpu: disable-file=XGT004``
+   anywhere in the file silences the rule(s) file-wide.  ``all`` names
+   every rule.  Suppressions are for sites where the pattern is
+   INTENTIONAL and the comment should say why.
+2. **baseline** — a committed JSON ledger of accepted legacy findings
+   (``ANALYSIS_BASELINE.json``).  Baselined findings do not fail the
+   build but are reported as "baselined" so the debt stays visible.
+   Keys are content-addressed (rule + path tail + source line text), so
+   unrelated edits that shift line numbers do not invalidate them.
+3. everything else fails (exit code 1 / the tier-1 test).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_DISABLE_RE = re.compile(
+    r"#\s*xgtpu:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<codes>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+#: rule code used for files the parser itself rejects
+PARSE_ERROR_RULE = "XGT000"
+
+
+def _iter_comments(source: str):
+    """Yield ``(lineno, text, is_comment_only_line)`` for every real
+    comment token.  Tokenize errors end the scan quietly (the caller
+    already ast-parsed the file; a trailing tokenize hiccup must not
+    kill suppression handling for the lines before it)."""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield (tok.start[0], tok.string,
+                       tok.line.lstrip().startswith("#"))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    @property
+    def baseline_key(self) -> str:
+        """Content-addressed identity: stable across line-number drift
+        AND across invocation styles (relative vs absolute paths) —
+        repo files key on their repo-root-relative path, so a baseline
+        written by ``tools/xgtpu_lint.py xgboost_tpu/`` matches a run
+        of ``python -m xgboost_tpu.analysis`` (absolute default path)."""
+        return f"{self.rule}|{_key_path(self.path)}|{self.snippet.strip()}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}")
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet.strip()}
+
+
+class Suppressions:
+    """Inline ``# xgtpu: disable=...`` directives for one file.
+
+    Directives are read from REAL comment tokens only (``tokenize``),
+    never from string literals or docstrings — prose that merely
+    *mentions* the syntax (this module's own docstring, ANALYSIS.md
+    excerpts quoted in code) must not disable anything."""
+
+    def __init__(self, source: str):
+        self.file_wide: Set[str] = set()
+        self.by_line: Dict[int, Set[str]] = {}
+        for lineno, text, own_line in _iter_comments(source):
+            m = _DISABLE_RE.search(text)
+            if not m:
+                continue
+            codes = {c.strip().upper()
+                     for c in m.group("codes").split(",") if c.strip()}
+            if m.group("file"):
+                self.file_wide |= codes
+                continue
+            self.by_line.setdefault(lineno, set()).update(codes)
+            if own_line:
+                # a comment-only suppression line also covers the next
+                # source line (the statement it annotates)
+                self.by_line.setdefault(lineno + 1, set()).update(codes)
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        def hit(codes: Set[str]) -> bool:
+            return "ALL" in codes or finding.rule.upper() in codes
+        if hit(self.file_wide):
+            return True
+        codes = self.by_line.get(finding.line, set())
+        return hit(codes)
+
+
+class FileContext:
+    """Everything a rule needs to inspect one parsed file."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST):
+        self.path = os.path.normpath(path).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    # ---------------------------------------------------------- tree helpers
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_loop(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest enclosing STATEMENT loop (for/while; comprehensions
+        do not count — they are expression-level and usually cold)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.For, ast.While)):
+                return anc
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                return None
+        return None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.path, line=line, col=col,
+                       message=message, snippet=self.line_text(line))
+
+
+# ------------------------------------------------------------------ helpers
+def terminal_name(func: ast.AST) -> Optional[str]:
+    """The last identifier of a call target: ``open`` for ``open`` and
+    ``io.open``, ``jit`` for ``jax.jit``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ------------------------------------------------------------------ baseline
+class Baseline:
+    """Committed ledger of accepted legacy findings (counts per
+    content-addressed key)."""
+
+    VERSION = 1
+
+    def __init__(self, counts: Optional[Dict[str, int]] = None):
+        self.counts: Dict[str, int] = dict(counts or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("version") != cls.VERSION:
+            raise ValueError(
+                f"{path}: unsupported baseline version "
+                f"{data.get('version')!r} (expected {cls.VERSION})")
+        counts = {str(k): int(v) for k, v in data.get("findings", {}).items()}
+        return cls(counts)
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        counts: Dict[str, int] = {}
+        for f in findings:
+            counts[f.baseline_key] = counts.get(f.baseline_key, 0) + 1
+        return cls(counts)
+
+    def dump(self, path: str) -> None:
+        data = {"version": self.VERSION,
+                "findings": dict(sorted(self.counts.items()))}
+        payload = (json.dumps(data, indent=2, sort_keys=False)
+                   + "\n").encode()
+        from xgboost_tpu.reliability.integrity import atomic_write
+        atomic_write(path, payload, durable=False)
+
+    def rescoped(self, findings: Sequence[Finding],
+                 scanned_paths: Sequence[str]) -> "Baseline":
+        """A new baseline where entries for files UNDER the scanned
+        paths are replaced by ``findings`` and entries elsewhere are
+        kept — so a partial-scan ``--write-baseline`` cannot silently
+        drop the rest of the accepted debt.  Coverage matching works on
+        repo-root-relative key paths; scanned paths outside the repo
+        replace nothing beyond their own re-found keys (the baseline is
+        a repo ledger)."""
+        prefixes: List[Tuple[str, bool]] = []
+        for p in scanned_paths:
+            prefixes.append((_key_path(os.fspath(p)),
+                             os.path.isdir(p)))
+
+        def covered(key: str) -> bool:
+            kpath = key.split("|", 2)[1]
+            for kp, is_dir in prefixes:
+                if kp in (".", ""):
+                    return True
+                if is_dir and kpath.startswith(kp.rstrip("/") + "/"):
+                    return True
+                if not is_dir and kpath == kp:
+                    return True
+            return False
+
+        kept = {k: v for k, v in self.counts.items() if not covered(k)}
+        merged = Baseline(kept)
+        for f in findings:
+            merged.counts[f.baseline_key] = (
+                merged.counts.get(f.baseline_key, 0) + 1)
+        return merged
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """-> (new findings, baselined findings).  Each baseline entry
+        absorbs at most its recorded count."""
+        budget = dict(self.counts)
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f in findings:
+            k = f.baseline_key
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old
+
+
+def default_baseline_path() -> str:
+    """``ANALYSIS_BASELINE.json`` next to the package (the repo root in
+    a source checkout)."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg_dir), "ANALYSIS_BASELINE.json")
+
+
+def _key_path(path: str) -> str:
+    """Baseline-key path form: repo-root-relative for files under the
+    repo, the last three components otherwise (tmp fixtures)."""
+    root = os.path.dirname(default_baseline_path())
+    try:
+        rel = os.path.relpath(os.path.abspath(path), root)
+    except ValueError:  # different drive (Windows)
+        rel = None
+    if rel is not None and not rel.startswith(".."):
+        return rel.replace(os.sep, "/")
+    parts = os.path.normpath(path).replace(os.sep, "/").split("/")
+    return "/".join(parts[-3:])
+
+
+# -------------------------------------------------------------------- runner
+@dataclasses.dataclass
+class Result:
+    """Outcome of one analysis run."""
+
+    findings: List[Finding]            # unsuppressed, non-baselined
+    baselined: List[Finding]
+    suppressed: List[Finding]
+    files_scanned: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "findings": [f.to_json() for f in self.findings],
+            "baselined": [f.to_json() for f in self.baselined],
+            "suppressed_count": len(self.suppressed),
+            "counts": self.rule_counts(),
+            "clean": self.clean,
+        }
+
+    def rule_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return counts
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__"
+                             and not d.startswith("."))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Sequence] = None
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """Lint one source string -> (active findings, suppressed findings).
+    Parse failures surface as a single XGT000 finding (never an
+    exception: the linter must report on a broken tree, not die on it).
+    """
+    from xgboost_tpu.analysis.rules import all_rules
+    if rules is None:
+        rules = all_rules()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        f = Finding(rule=PARSE_ERROR_RULE, path=path,
+                    line=e.lineno or 1, col=e.offset or 0,
+                    message=f"file does not parse: {e.msg}")
+        return [f], []
+    ctx = FileContext(path, source, tree)
+    sup = Suppressions(source)
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rule in rules:
+        if not rule.applies(ctx.path):
+            continue
+        for f in rule.check(ctx):
+            (suppressed if sup.is_suppressed(f) else active).append(f)
+    active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return active, suppressed
+
+
+def run(paths: Sequence[str], baseline: Optional[Baseline] = None,
+        rules: Optional[Sequence] = None) -> Result:
+    """Lint every ``.py`` file under ``paths``."""
+    from xgboost_tpu.analysis.rules import all_rules
+    if rules is None:
+        rules = all_rules()
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    n_files = 0
+    for path in iter_py_files(paths):
+        n_files += 1
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding(
+                rule=PARSE_ERROR_RULE, path=path, line=1, col=0,
+                message=f"unreadable: {e}"))
+            continue
+        active, sup = analyze_source(source, path, rules)
+        findings.extend(active)
+        suppressed.extend(sup)
+    if baseline is not None:
+        new, old = baseline.split(findings)
+    else:
+        new, old = findings, []
+    return Result(findings=new, baselined=old, suppressed=suppressed,
+                  files_scanned=n_files)
+
+
+def render_report(result: Result, out=None, verbose: bool = False) -> None:
+    out = out if out is not None else sys.stdout
+    for f in result.findings:
+        print(f.render(), file=out)
+    if verbose:
+        for f in result.baselined:
+            print(f"{f.render()}  [baselined]", file=out)
+    counts = result.rule_counts()
+    summary = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    print(f"xgtpu-lint: {result.files_scanned} files, "
+          f"{len(result.findings)} finding(s)"
+          + (f" ({summary})" if summary else "")
+          + (f", {len(result.baselined)} baselined" if result.baselined
+             else "")
+          + (f", {len(result.suppressed)} suppressed"
+             if result.suppressed else ""),
+          file=out)

@@ -1,0 +1,597 @@
+"""The xgtpu-lint rule catalog (ANALYSIS.md has rationale + fix
+recipes per rule).
+
+Each rule encodes one invariant the codebase established in an earlier
+PR and that no generic tool checks:
+
+  XGT001  recompile hazards around ``jax.jit``
+  XGT002  host<->device synchronization inside hot training loops
+  XGT003  durable writes bypassing ``reliability.integrity.atomic_write``
+  XGT004  broad exception handlers that swallow errors silently
+  XGT005  mutation of lock-guarded attributes outside the lock
+  XGT006  wall-clock ``time.time()`` used to measure durations
+  XGT007  collectives under rank-dependent control flow
+
+Rules are heuristic by design: they aim at THIS tree's hazards, with
+inline ``# xgtpu: disable=`` suppressions (plus the committed baseline)
+as the escape hatch for intentional sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Iterator, List, Optional, Sequence, Set
+
+from xgboost_tpu.analysis.core import (FileContext, Finding, const_str,
+                                       dotted_name, terminal_name)
+
+
+class Rule:
+    """One lint rule: a code, a short name, and a ``check`` generator."""
+
+    code = "XGT000"
+    name = "base"
+
+    def applies(self, path: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _path_has(path: str, needles: Sequence[str]) -> bool:
+    return any(n in path for n in needles)
+
+
+# ---------------------------------------------------------------- XGT001
+def _is_jit_target(node: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` (the only way it is imported here)."""
+    return (dotted_name(node) in ("jax.jit", "jit")
+            or (isinstance(node, ast.Attribute) and node.attr == "jit"))
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if const_str(v):
+                names.add(const_str(v))
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    s = const_str(elt)
+                    if s:
+                        names.add(s)
+    return names
+
+
+def _jit_decoration(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """Static argnames when ``fn`` is jit-decorated (directly or via
+    ``functools.partial(jax.jit, ...)``), else None."""
+    for dec in fn.decorator_list:
+        if _is_jit_target(dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            if _is_jit_target(dec.func):
+                return _static_argnames(dec)
+            if (terminal_name(dec.func) == "partial" and dec.args
+                    and _is_jit_target(dec.args[0])):
+                return _static_argnames(dec)
+    return None
+
+
+class RecompileHazards(Rule):
+    """XGT001: patterns that retrace/recompile per call or per value.
+
+    (a) a ``jax.jit`` wrapper constructed inside a loop — a fresh
+        wrapper per iteration; for lambdas/closures a fresh cache key,
+        i.e. a recompile every iteration;
+    (b) ``jax.jit(f)(...)`` built and invoked in one expression inside a
+        function body — re-wrapped on every execution of that line;
+    (c) Python ``if``/``while`` branching on a NON-static parameter's
+        shape inside a jitted function — every distinct shape traces a
+        new program (pad to a bucket, or make the argument static);
+    (d) a jitted callable fed a loop-varying slice (``f(x[:i])``) —
+        one compile per distinct length.
+    """
+
+    code = "XGT001"
+    name = "recompile-hazard"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        jitted_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.FunctionDef)
+                    and _jit_decoration(node) is not None):
+                jitted_names.add(node.name)
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _is_jit_target(node.value.func)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted_names.add(t.id)
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_jit_target(node.func):
+                if ctx.enclosing_loop(node) is not None:
+                    yield ctx.finding(
+                        self.code, node,
+                        "jax.jit wrapper constructed inside a loop: a "
+                        "fresh wrapper (and for lambdas a fresh compile-"
+                        "cache key) per iteration — hoist the jitted "
+                        "callable out of the loop")
+                elif (isinstance(ctx.parent(node), ast.Call)
+                      and ctx.parent(node).func is node
+                      and node.args
+                      and isinstance(node.args[0], ast.Lambda)):
+                    yield ctx.finding(
+                        self.code, node,
+                        "jax.jit(lambda...)(args) built and invoked in "
+                        "one expression: the wrapper (and its compile "
+                        "cache entry) is rebuilt on every execution — "
+                        "bind the jitted function once at module/init "
+                        "scope")
+            if isinstance(node, ast.FunctionDef):
+                statics = _jit_decoration(node)
+                if statics is not None:
+                    yield from self._shape_branches(ctx, node, statics)
+            if isinstance(node, ast.Call):
+                fname = terminal_name(node.func)
+                if fname in jitted_names:
+                    yield from self._loop_varying_args(ctx, node)
+
+    def _shape_branches(self, ctx: FileContext, fn: ast.FunctionDef,
+                        statics: Set[str]) -> Iterator[Finding]:
+        params = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
+                                  + fn.args.posonlyargs)} - statics
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for sub in ast.walk(node.test):
+                hit = None
+                if (isinstance(sub, ast.Attribute)
+                        and sub.attr in ("shape", "ndim", "size")
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id in params):
+                    hit = f"{sub.value.id}.{sub.attr}"
+                elif (isinstance(sub, ast.Call)
+                      and terminal_name(sub.func) == "len"
+                      and sub.args
+                      and isinstance(sub.args[0], ast.Name)
+                      and sub.args[0].id in params):
+                    hit = f"len({sub.args[0].id})"
+                if hit:
+                    yield ctx.finding(
+                        self.code, node,
+                        f"shape-dependent Python branch on {hit} inside "
+                        f"jitted {fn.name}(): each distinct shape traces "
+                        "a new program — pad to a fixed bucket or mark "
+                        "the argument in static_argnames")
+                    break
+
+    def _loop_varying_args(self, ctx: FileContext,
+                           call: ast.Call) -> Iterator[Finding]:
+        loop = ctx.enclosing_loop(call)
+        if not isinstance(loop, ast.For):
+            return
+        loop_vars = {n.id for n in ast.walk(loop.target)
+                     if isinstance(n, ast.Name)}
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if not (isinstance(sub, ast.Subscript)
+                        and isinstance(sub.slice, ast.Slice)):
+                    continue
+                bounds = [b for b in (sub.slice.lower, sub.slice.upper,
+                                      sub.slice.step) if b is not None]
+                if any(isinstance(n, ast.Name) and n.id in loop_vars
+                       for b in bounds for n in ast.walk(b)):
+                    yield ctx.finding(
+                        self.code, call,
+                        "jitted function called with a loop-varying "
+                        "slice: one compile per distinct length — pad "
+                        "to a fixed shape (or lift the loop into the "
+                        "jitted program)")
+                    return
+
+
+# ---------------------------------------------------------------- XGT002
+class HostSyncInHotLoop(Rule):
+    """XGT002: host<->device synchronization inside the per-round /
+    per-node loops of the training hot path.  Each ``.item()`` /
+    ``np.asarray`` / ``device_get`` on a device value forces a blocking
+    transfer per iteration, serializing the device pipeline (the exact
+    cost class arXiv:1806.11248 §4 removes from the GPU hist method).
+    Scoped to the hot-path files; cold paths (save/load, dump) live
+    elsewhere or use comprehensions, which are not flagged.
+    """
+
+    code = "XGT002"
+    name = "host-sync-in-hot-loop"
+
+    HOT_PATHS = ("models/gbtree.py", "models/updaters.py", "ops/")
+
+    def applies(self, path: str) -> bool:
+        return _path_has(path, self.HOT_PATHS)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.enclosing_loop(node) is None:
+                continue
+            msg = self._sync_call(node)
+            if msg:
+                yield ctx.finding(
+                    self.code, node,
+                    f"{msg} inside a hot-path loop forces a host<->"
+                    "device sync per iteration — batch the transfer "
+                    "outside the loop or keep the value on device")
+
+    @staticmethod
+    def _sync_call(node: ast.Call) -> Optional[str]:
+        d = dotted_name(node.func)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item" and not node.args):
+            return ".item()"
+        if d in ("np.asarray", "np.array", "numpy.asarray", "numpy.array"):
+            # converting a literal list/tuple/comprehension is pure
+            # host work, not a device pull
+            if node.args and not isinstance(
+                    node.args[0], (ast.List, ast.Tuple, ast.ListComp,
+                                   ast.GeneratorExp, ast.Constant)):
+                return d + "()"
+            return None
+        if d in ("jax.device_get", "device_get"):
+            return d + "()"
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int") and node.args
+                and isinstance(node.args[0], ast.Subscript)):
+            return f"{node.func.id}(array[...])"
+        return None
+
+
+# ---------------------------------------------------------------- XGT003
+_WRITE_MODE = frozenset("wx")
+
+
+def _mode_writes(mode: Optional[str]) -> bool:
+    return bool(mode) and any(c in _WRITE_MODE for c in mode)
+
+
+class NonAtomicPersistence(Rule):
+    """XGT003: durable files written with plain ``open(..., 'w')`` (or a
+    kept ``NamedTemporaryFile``): a crash mid-write leaves a torn
+    prefix where ``reliability.integrity.atomic_write`` would leave
+    old-or-new.  Append mode is exempt (the event log's contract: a
+    crash tears at most the final line, never the file)."""
+
+    code = "XGT003"
+    name = "non-atomic-persistence"
+
+    EXEMPT_FILES = ("reliability/integrity.py",)  # the implementation
+    _MODE_RE = re.compile(r"[rwxab+tU]{1,4}\Z")
+
+    def applies(self, path: str) -> bool:
+        return not _path_has(path, self.EXEMPT_FILES)
+
+    @classmethod
+    def _open_mode(cls, node: ast.Call) -> Optional[str]:
+        """The constant mode of an ``open``-named call, wherever the
+        calling convention puts it: builtin/``io.open``/``gzip.open``
+        take it as the 2nd positional, ``Path.open``/``fsspec.open``
+        as the 1st — so scan the first two positionals for a
+        mode-SHAPED constant string (a path literal like ``"out.txt"``
+        never matches the mode charset), plus the ``mode=`` keyword."""
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                return const_str(kw.value)
+        for arg in node.args[:2]:
+            s = const_str(arg)
+            if s is not None and cls._MODE_RE.match(s):
+                return s
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = terminal_name(node.func)
+            if fname == "open":
+                mode = self._open_mode(node)
+                if _mode_writes(mode):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"open(..., {mode!r}) writes the destination in "
+                        "place — a crash mid-write leaves a torn file; "
+                        "route through reliability.integrity."
+                        "atomic_write (tmp+rename)")
+            elif fname == "NamedTemporaryFile":
+                mode = const_str(node.args[0]) if node.args else "w+b"
+                delete = True
+                for kw in node.keywords:
+                    if kw.arg == "mode":
+                        mode = const_str(kw.value)
+                    if (kw.arg == "delete"
+                            and isinstance(kw.value, ast.Constant)):
+                        delete = bool(kw.value.value)
+                if _mode_writes(mode) and not delete:
+                    yield ctx.finding(
+                        self.code, node,
+                        "NamedTemporaryFile(delete=False) persists a "
+                        "file without the tmp+rename discipline — write "
+                        "the final path via reliability.integrity."
+                        "atomic_write instead")
+
+
+# ---------------------------------------------------------------- XGT004
+_BROAD_EXC = ("Exception", "BaseException")
+#: a call to any of these inside a handler counts as surfacing the error
+_SURFACE_CALLS = frozenset({
+    "print", "print_exc", "format_exc", "warn", "warning", "error",
+    "exception", "critical", "log", "debug", "info", "fail", "event",
+    "emit", "inc", "observe", "swallowed_error", "perror"})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        tn = terminal_name(n)
+        if tn in _BROAD_EXC:
+            return True
+    return False
+
+
+class SwallowedException(Rule):
+    """XGT004: a broad ``except`` whose handler neither re-raises, nor
+    references the exception, nor calls anything that surfaces it (log/
+    print/obs event/metric inc) — the error vanishes.  Fix recipe:
+    ``obs.swallowed_error(site, exc)`` (counted on
+    ``xgbtpu_swallowed_errors_total{site=...}`` + a throttled obs
+    event), or narrow the except, or re-raise typed."""
+
+    code = "XGT004"
+    name = "swallowed-exception"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if self._surfaces(node):
+                continue
+            yield ctx.finding(
+                self.code, node,
+                "broad except swallows the error with no re-raise, log, "
+                "obs event, or metric — call obs.swallowed_error(site, "
+                "exc) (or narrow/re-raise) so failures stay countable")
+
+    @staticmethod
+    def _surfaces(handler: ast.ExceptHandler) -> bool:
+        bound = handler.name
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                tn = terminal_name(node.func)
+                if tn in _SURFACE_CALLS:
+                    return True
+                if tn and any(s in tn.lower()
+                              for s in ("log", "warn", "error", "swallow")):
+                    return True
+            if (bound and isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id == bound):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------- XGT005
+def _with_lock_attrs(node: ast.With) -> List[str]:
+    """Lock attribute names entered by a ``with`` statement
+    (``with self._lock:`` -> ['_lock'])."""
+    out = []
+    for item in node.items:
+        e = item.context_expr
+        if (isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == "self"):
+            out.append(e.attr)
+    return out
+
+
+class LockDiscipline(Rule):
+    """XGT005: an attribute that is elsewhere mutated under ``with
+    self.<lock>:`` is mutated here with NO lock held — a data race once
+    two threads touch the object.  Analysis is per class: ``__init__``
+    (single-threaded construction) and ``*_locked`` helper methods
+    (called with the lock held, by convention) are exempt."""
+
+    code = "XGT005"
+    name = "lock-discipline"
+
+    EXEMPT_METHODS = ("__init__", "__new__", "__del__")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _self_attr_writes(self, stmt: ast.AST) -> Iterable[str]:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if (isinstance(e, ast.Attribute)
+                        and isinstance(e.value, ast.Name)
+                        and e.value.id == "self"):
+                    yield e.attr
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        lock_names: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.With):
+                for attr in _with_lock_attrs(node):
+                    if "lock" in attr.lower():
+                        lock_names.add(attr)
+        if not lock_names:
+            return
+
+        def under_lock(node: ast.AST) -> bool:
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, ast.With) and any(
+                        a in lock_names for a in _with_lock_attrs(anc)):
+                    return True
+                if anc is cls:
+                    return False
+            return False
+
+        def method_of(node: ast.AST) -> Optional[ast.FunctionDef]:
+            fn = None
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = anc
+                if anc is cls:
+                    return fn
+            return None
+
+        guarded: Set[str] = set()
+        writes: List = []  # (attr, stmt)
+        for node in ast.walk(cls):
+            for attr in self._self_attr_writes(node):
+                if attr in lock_names:
+                    continue
+                m = method_of(node)
+                if m is None or m.name in self.EXEMPT_METHODS:
+                    continue
+                if under_lock(node):
+                    guarded.add(attr)
+                elif not m.name.endswith("_locked"):
+                    writes.append((attr, node))
+        for attr, stmt in writes:
+            if attr in guarded:
+                yield ctx.finding(
+                    self.code, stmt,
+                    f"self.{attr} is mutated under a lock elsewhere in "
+                    f"{cls.name} but written here with no lock held — "
+                    "wrap in the guarding `with self.<lock>:` (or name "
+                    "the method *_locked if the caller holds it)")
+
+
+# ---------------------------------------------------------------- XGT006
+class WallClockDuration(Rule):
+    """XGT006: a duration measured as a difference of wall-clock
+    ``time.time()`` readings — NTP steps/slews make it lie (negative or
+    inflated).  Use ``time.perf_counter()`` for durations; wall-clock
+    stays correct for event-log TIMESTAMPS (never flagged: only
+    subtractions are)."""
+
+    code = "XGT006"
+    name = "wallclock-duration"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            for side in (node.left, node.right):
+                if (isinstance(side, ast.Call)
+                        and dotted_name(side.func) == "time.time"):
+                    yield ctx.finding(
+                        self.code, node,
+                        "duration measured with wall-clock time.time() "
+                        "— an NTP step mid-measurement skews it; use "
+                        "time.perf_counter() (keep time.time() only for "
+                        "event timestamps)")
+                    break
+
+
+# ---------------------------------------------------------------- XGT007
+_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "reduce_scatter", "broadcast_one_to_all", "allreduce",
+    "allgather", "allgatherv", "allsum", "collective",
+    "process_allgather"})
+
+
+class CollectiveUnderRankBranch(Rule):
+    """XGT007: a collective executed under control flow whose condition
+    differs across ranks (``if rank == 0: psum(...)``) — the other
+    ranks never enter the collective and the mesh deadlocks (or
+    silently diverges).  Every rank must execute the same collective
+    sequence; branch on rank AROUND the data, not around the
+    collective."""
+
+    code = "XGT007"
+    name = "collective-under-rank-branch"
+
+    SCOPED_PATHS = ("parallel/", "cli.py", "models/gbtree.py",
+                    "obs/comm.py")
+
+    def applies(self, path: str) -> bool:
+        return _path_has(path, self.SCOPED_PATHS)
+
+    @staticmethod
+    def _rank_dependent(test: ast.AST) -> bool:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Name) and sub.id == "rank":
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr in (
+                    "rank", "process_index"):
+                return True
+            if (isinstance(sub, ast.Call)
+                    and terminal_name(sub.func) == "process_index"):
+                return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) not in _COLLECTIVES:
+                continue
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break
+                test = None
+                if isinstance(anc, (ast.If, ast.While)):
+                    test = anc.test
+                elif isinstance(anc, ast.IfExp):
+                    test = anc.test
+                if test is not None and self._rank_dependent(test):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"collective {terminal_name(node.func)}() under "
+                        "rank-dependent control flow: ranks that skip "
+                        "the branch never join the collective — "
+                        "deadlock/divergence; run the collective on "
+                        "every rank and branch on the data instead")
+                    break
+
+
+_ALL_RULES = (RecompileHazards, HostSyncInHotLoop, NonAtomicPersistence,
+              SwallowedException, LockDiscipline, WallClockDuration,
+              CollectiveUnderRankBranch)
+
+
+def all_rules() -> List[Rule]:
+    """Fresh instances of every registered rule, in code order."""
+    return [cls() for cls in _ALL_RULES]
+
+
+def rules_by_code(codes: Iterable[str]) -> List[Rule]:
+    wanted = {c.strip().upper() for c in codes}
+    out = [cls() for cls in _ALL_RULES if cls.code in wanted]
+    unknown = wanted - {cls.code for cls in _ALL_RULES}
+    if unknown:
+        raise ValueError(f"unknown rule code(s): {sorted(unknown)}")
+    return out

@@ -139,7 +139,11 @@ def _rank0() -> bool:
     try:
         import jax
         return jax.process_index() == 0
-    except Exception:
+    except Exception as e:
+        # no backend yet (or none at all): act as rank 0 so the warning
+        # still prints somewhere; the probe failure itself is counted
+        from xgboost_tpu.obs.metrics import swallowed_error
+        swallowed_error("binning.rank0_probe", e, emit_event=False)
         return True
 
 

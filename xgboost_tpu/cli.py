@@ -30,7 +30,9 @@ from typing import Dict, List, Optional, Tuple
 
 from xgboost_tpu.config import SERVE_PARAMS, parse_config_file
 
-_T0 = time.time()  # process start, for recovery-cost accounting
+# process start, for recovery-cost accounting.  perf_counter, not
+# wall-clock: these readings are only ever subtracted (XGT006)
+_T0 = time.perf_counter()
 
 _USAGE = """\
 Usage: python -m xgboost_tpu <config> [name=value ...]
@@ -375,7 +377,8 @@ class BoostLearnTask:
         (reference TaskTrain round loop, xgboost_main.cpp:175-229)."""
         for i in range(start_round, self.num_round):
             if not self.silent:
-                print(f"boosting round {i}, {time.time() - start:.0f} sec "
+                print(f"boosting round {i}, "
+                      f"{time.perf_counter() - start:.0f} sec "
                       "elapsed", file=sys.stderr)
             bst.update(data, i)
             if evals:
@@ -420,10 +423,10 @@ class BoostLearnTask:
                 # recompile cost lands inside the first resumed round
                 # (or not, with the persistent jit cache below)
                 print(f"[ckpt] resume at round {start_round} "
-                      f"({time.time() - _T0:.2f}s from process start)",
-                      file=sys.stderr)
+                      f"({time.perf_counter() - _T0:.2f}s from process "
+                      "start)", file=sys.stderr)
 
-        start = time.time()
+        start = time.perf_counter()
         # nothing runs on the host between rounds (no eval lines, no
         # periodic saves, no per-round checkpoint): fuse the whole round
         # loop into one device launch (update_many falls back per-round
@@ -452,7 +455,8 @@ class BoostLearnTask:
             bst._profiler.print_summary()
             bst._profiler.stop()
         if not self.silent:
-            print(f"\nupdating end, {time.time() - start:.0f} sec in all",
+            print(f"\nupdating end, "
+                  f"{time.perf_counter() - start:.0f} sec in all",
                   file=sys.stderr)
         return 0
 
@@ -467,14 +471,20 @@ class BoostLearnTask:
                             ntree_limit=self.ntree_limit)
         if not self.silent:
             print(f"writing prediction to {self.name_pred}")
-        out = sys.stdout if self.name_pred == "stdout" else open(
-            self.name_pred, "w")
-        try:
+        if self.name_pred == "stdout":
             for p in preds.reshape(-1):
-                out.write(f"{p:g}\n")
-        finally:
-            if out is not sys.stdout:
-                out.close()
+                sys.stdout.write(f"{p:g}\n")
+        else:
+            # streamed into the tmp+rename staging file (XGT003): a
+            # killed pred job leaves the previous complete output or
+            # the new one, never a torn prefix a downstream consumer
+            # would half-read — and a multi-million-row output is never
+            # materialized in memory (no CRC footer: text output, not
+            # a model file)
+            from xgboost_tpu.reliability.integrity import atomic_writer
+            with atomic_writer(self.name_pred) as f:
+                for p in preds.reshape(-1):
+                    f.write(f"{p:g}\n".encode())
         return 0
 
     # -------------------------------------------------------------- eval
@@ -515,9 +525,9 @@ class BoostLearnTask:
         assert self.model_in, "model_in not specified"
         bst = self._make_booster()
         dumps = bst.get_dump(self.name_fmap, with_stats=self.dump_stats != 0)
-        with open(self.name_dump, "w") as f:
-            for i, s in enumerate(dumps):
-                f.write(f"booster[{i}]:\n{s}")
+        from xgboost_tpu.reliability.integrity import atomic_write
+        text = "".join(f"booster[{i}]:\n{s}" for i, s in enumerate(dumps))
+        atomic_write(self.name_dump, text.encode())
         return 0
 
 

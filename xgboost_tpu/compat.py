@@ -360,8 +360,10 @@ def save_reference_model(booster, path: Optional[str] = None,
     else:
         data = b"binf" + payload
     if path is not None:
-        with open(path, "wb") as f:
-            f.write(data)
+        # reference-format exports are durable model files: same
+        # tmp+rename discipline as the native save path (XGT003)
+        from xgboost_tpu.reliability.integrity import atomic_write
+        atomic_write(path, data)
     return data
 
 

@@ -329,8 +329,13 @@ class DMatrix:
         if self.info.group_ptr is not None:
             fields["meta_group_ptr"] = self.info.group_ptr
         # write through a file object: np.savez(str) appends ".npz",
-        # which would break the reference's name.buffer convention
-        with open(path, "wb") as f:
+        # which would break the reference's name.buffer convention.
+        # Streamed into the tmp+rename staging file (XGT003): a crash
+        # mid-save must not leave a torn cache that every later run
+        # trusts blindly — and the cache can be the biggest file this
+        # process writes, so no in-memory copy of the archive either
+        from xgboost_tpu.reliability.integrity import atomic_writer
+        with atomic_writer(path) as f:
             np.savez(f, **fields)
 
     @classmethod

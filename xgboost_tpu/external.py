@@ -447,8 +447,11 @@ def _default_device_budget() -> int:
                 if limit:
                     free = limit - stats.get("bytes_in_use", 0)
                     budget = max(free // 2, 0)
-            except Exception:
-                pass  # backends without memory_stats keep the default
+            except Exception as e:
+                # backends without memory_stats keep the default
+                from xgboost_tpu.obs.metrics import swallowed_error
+                swallowed_error("external.memory_budget", e,
+                                emit_event=False)
         _budget_cache = budget
     return _budget_cache
 

@@ -71,6 +71,9 @@ def load_dmatrix_into(dmat, uri: str, silent: bool = True,
                 "data=stdin cannot be used under the multi-worker "
                 "launcher: every worker would race on one inherited "
                 "stdin pipe; pass a file path instead")
+        # scratch spool, unlinked in the finally below — not a durable
+        # destination, so tmp+rename buys nothing here
+        # xgtpu: disable=XGT003
         with tempfile.NamedTemporaryFile("wb", suffix=".libsvm",
                                          delete=False) as tf:
             tf.write(sys.stdin.buffer.read())
@@ -149,6 +152,9 @@ def _fetch_remote(uri: str) -> str:
     elif uri.startswith("hdfs://") and shutil.which("hdfs"):
         cmd = ["hdfs", "dfs", "-cat", uri]
 
+    # scratch spool for the remote fetch; the caller unlinks it after
+    # loading (and the except below unlinks on failure) — not durable
+    # xgtpu: disable=XGT003
     with tempfile.NamedTemporaryFile("wb", suffix=".libsvm",
                                      delete=False) as tf:
         try:

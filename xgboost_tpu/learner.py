@@ -1356,9 +1356,9 @@ class Booster:
 
     def dump_model(self, fout: str, fmap: str = "", with_stats: bool = False):
         dumps = self.get_dump(fmap, with_stats)
-        with open(fout, "w") as f:
-            for i, s in enumerate(dumps):
-                f.write(f"booster[{i}]:\n{s}")
+        from xgboost_tpu.reliability.integrity import atomic_write
+        atomic_write(fout, "".join(
+            f"booster[{i}]:\n{s}" for i, s in enumerate(dumps)).encode())
 
     def get_fscore(self, fmap: str = "") -> Dict[str, int]:
         """Split-count feature importance (wrapper/xgboost.py:512-530)."""

@@ -23,6 +23,10 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+# module-level import so __del__ can still account failures during
+# interpreter shutdown, when function-local imports start failing
+from xgboost_tpu.obs.metrics import swallowed_error
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libxgtpu_io.so")
@@ -47,7 +51,10 @@ def _build() -> bool:
         subprocess.run(["make", "-C", _NATIVE_DIR, "lib"], check=True,
                        capture_output=True, timeout=120)
         return os.path.exists(_LIB_PATH)
-    except Exception:
+    except Exception as e:
+        # no toolchain -> pure-Python fallback; the degradation is
+        # counted so a fleet silently parsing at 1/8 speed shows up
+        swallowed_error("native.build", e)
         return False
 
 
@@ -176,8 +183,8 @@ class PageWriter:
     def __del__(self):
         try:
             self.close()  # flush the C++ stream even without close()
-        except Exception:
-            pass
+        except Exception as e:
+            swallowed_error("native.page_writer_del", e, emit_event=False)
 
 
 class PageReader:
@@ -225,5 +232,5 @@ class PageReader:
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception as e:
+            swallowed_error("native.page_reader_del", e, emit_event=False)

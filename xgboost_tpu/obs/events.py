@@ -56,7 +56,12 @@ class EventLog:
         try:
             line = json.dumps(record, separators=(",", ":"),
                               default=str).encode() + b"\n"
-        except Exception:
+        except Exception as e:
+            # even the str() fallback failed (a repr that raises): the
+            # record is lost, but the loss is COUNTED — emit_event=False
+            # because we ARE the event log (recursion guard)
+            from xgboost_tpu.obs.metrics import swallowed_error
+            swallowed_error("obs.events.format", e, emit_event=False)
             return
         with self._lock:
             try:
@@ -68,8 +73,11 @@ class EventLog:
                     self._last_fsync = now
                 if self._f.tell() >= self.rotate_bytes:
                     self._rotate_locked()
-            except (OSError, ValueError):
-                pass
+            except (OSError, ValueError) as e:
+                # full disk / closed file degrades observability, not
+                # training — but the dropped line is counted
+                from xgboost_tpu.obs.metrics import swallowed_error
+                swallowed_error("obs.events.write", e, emit_event=False)
 
     def _rotate_locked(self) -> None:
         """Rotate ``path`` -> ``path.1`` (one generation kept) with the

@@ -280,6 +280,64 @@ def registry() -> MetricsRegistry:
     return _REGISTRY
 
 
+# ------------------------------------------------------------------ errors
+_SWALLOWED: Optional[LabeledCounter] = None
+_SWALLOWED_LOCK = threading.Lock()
+_SWALLOW_EVENT_INTERVAL_S = 60.0
+_swallow_last_event: Dict[str, float] = {}
+_swallow_tls = threading.local()
+
+
+def swallowed_errors() -> LabeledCounter:
+    """The process-wide ``xgbtpu_swallowed_errors_total{site}`` family:
+    every deliberately swallowed exception in the tree is counted here
+    (the XGT004 lint rule enforces it), so "errors that vanish" become
+    a scrapeable number instead of silence."""
+    global _SWALLOWED
+    if _SWALLOWED is None:
+        with _SWALLOWED_LOCK:
+            if _SWALLOWED is None:
+                c = LabeledCounter(
+                    "xgbtpu_swallowed_errors_total", "site",
+                    "exceptions deliberately swallowed, by site")
+                registry().register("errors", c.render)
+                _SWALLOWED = c
+    return _SWALLOWED
+
+
+def swallowed_error(site: str, exc: Optional[BaseException] = None,
+                    emit_event: bool = True) -> None:
+    """Account a deliberately swallowed exception — the XGT004 fix
+    recipe (ANALYSIS.md): increments
+    ``xgbtpu_swallowed_errors_total{site=...}`` and, at most once per
+    site per minute, emits a throttled ``error.swallowed`` obs event.
+
+    NEVER raises: this runs inside ``except`` blocks on paths (the
+    event log's own write failure, ``__del__`` at interpreter shutdown)
+    where a second failure must not escape.  ``emit_event=False`` keeps
+    callers that sit UNDER the event log (obs/events.py itself) from
+    recursing into it; a thread-local guard backstops the same."""
+    try:
+        swallowed_errors().inc(site)
+        if not emit_event or getattr(_swallow_tls, "active", False):
+            return
+        now = time.monotonic()
+        with _SWALLOWED_LOCK:
+            last = _swallow_last_event.get(site)
+            if last is not None and now - last < _SWALLOW_EVENT_INTERVAL_S:
+                return
+            _swallow_last_event[site] = now
+        _swallow_tls.active = True
+        try:
+            from xgboost_tpu.obs.trace import event
+            event("error.swallowed", site=site,
+                  error=f"{type(exc).__name__}: {exc}" if exc else "")
+        finally:
+            _swallow_tls.active = False
+    except Exception:  # xgtpu: disable=XGT004 — accounting must not raise
+        pass
+
+
 # ------------------------------------------------------------- reliability
 class ReliabilityMetrics:
     """Process-wide failure-path accounting (RELIABILITY.md): how often
@@ -394,8 +452,11 @@ class TrainingMetrics:
             stats = jax.local_devices()[0].memory_stats()
             if stats:
                 self.device_memory.set(float(stats.get("bytes_in_use", 0)))
-        except Exception:
-            pass
+        except Exception as e:
+            # CPU backends report no memory stats; the gauge stays 0 —
+            # but the miss is counted, not invisible
+            swallowed_error("obs.metrics.device_memory", e,
+                            emit_event=False)
 
     def render(self) -> str:
         self.refresh_device_memory()
@@ -430,7 +491,7 @@ class ServingMetrics:
         self.prefix = prefix
         self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()  # uptime is a DURATION (XGT006)
         p = prefix
         self.requests = self.counter(
             f"{p}_requests_total", "prediction requests received")
@@ -484,7 +545,7 @@ class ServingMetrics:
     # ------------------------------------------------------------- render
     @property
     def uptime_seconds(self) -> float:
-        return time.time() - self._t0
+        return time.perf_counter() - self._t0
 
     def quantiles(self, qs: Tuple[float, ...] = (0.5, 0.99)
                   ) -> Dict[float, float]:

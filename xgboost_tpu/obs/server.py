@@ -49,7 +49,7 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps({
                 "status": "ok",
                 "uptime_seconds": round(
-                    time.time() - self.server.obs_t0, 3),
+                    time.perf_counter() - self.server.obs_t0, 3),
                 "rounds_completed": int(tm.rounds.value),
                 "round": int(tm.round.value),
                 "rank": self.server.obs_rank,
@@ -68,7 +68,7 @@ class MetricsServer:
                  rank: int = 0):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
-        self._httpd.obs_t0 = time.time()
+        self._httpd.obs_t0 = time.perf_counter()  # uptime = duration
         self._httpd.obs_rank = rank
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(

@@ -109,7 +109,11 @@ def fitted_round_model() -> Optional[dict]:
             m = json.load(f)
         float(m["fixed_round_s"]), float(m["per_row_s"])
         return m
-    except Exception:
+    except Exception as e:
+        # a torn/hand-edited fit file falls back to the analytic model;
+        # counted so a projection silently ignoring the fit is visible
+        from xgboost_tpu.obs.metrics import swallowed_error
+        swallowed_error("parallel.commcost.round_model", e)
         return None
 
 

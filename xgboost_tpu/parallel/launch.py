@@ -140,7 +140,7 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
     trial = 0
     while True:
         coord = f"localhost:{free_port()}"
-        t_attempt = time.time()
+        t_attempt = time.perf_counter()  # duration anchor (XGT006)
 
         def spawn(rank: int) -> subprocess.Popen:
             env = dict(os.environ)
@@ -170,7 +170,7 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
                     break
         if failed_rc is None:
             return 0
-        t_detect = time.time()
+        t_detect = time.perf_counter()
         _reap(procs)
         if not keepalive or trial >= max_restarts:
             return failed_rc
@@ -179,7 +179,7 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
         # to death detection, plus the reap (SIGTERM the survivors)
         print(f"[launch] restarting all {n} workers, trial {trial} "
               f"(attempt ran {t_detect - t_attempt:.2f}s, "
-              f"reap {time.time() - t_detect:.2f}s)",
+              f"reap {time.perf_counter() - t_detect:.2f}s)",
               file=sys.stderr)
 
 

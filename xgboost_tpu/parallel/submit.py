@@ -117,10 +117,14 @@ def submit(n: int, cmd: List[str], mode: str = "local",
         if dry_run:
             print(script, end="")
             return 0
-        with tempfile.NamedTemporaryFile(
-                "w", suffix=".sh", delete=False) as f:
-            f.write(script)
-            path = f.name
+        # tmp+rename (XGT003): qsub must never see a torn script — a
+        # half-written job file would submit N workers running a
+        # truncated command line (no fsync: the scheduler reads it
+        # back immediately, durability across a crash is moot)
+        from xgboost_tpu.reliability.integrity import atomic_write
+        path = os.path.join(tempfile.mkdtemp(prefix="xgtpu-submit-"),
+                            "job.sh")
+        atomic_write(path, script.encode(), durable=False)
         return subprocess.call(["qsub", path])
     if mode == "slurm":
         line = slurm_command(n, coord, cmd)

@@ -26,6 +26,7 @@ seams, so chaos tests corrupt/starve the REAL write and read paths.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 import sys
@@ -117,13 +118,19 @@ def _count_integrity_failure(name: str = "<buffer>",
     event("integrity.failure", file=name, reason=reason)
 
 
-def atomic_write(path: Union[str, os.PathLike], data: bytes,
-                 durable: bool = True) -> None:
-    """Crash-safe whole-file write: tmp file in the destination
-    directory -> flush -> fsync -> ``os.replace`` -> directory fsync.
-    ``durable=False`` skips the fsyncs (scratch files, tests)."""
+@contextlib.contextmanager
+def atomic_writer(path: Union[str, os.PathLike], durable: bool = True):
+    """Context manager yielding a binary file object staged in the
+    destination directory; a clean exit flushes, fsyncs, ``os.replace``-s
+    it over ``path`` and fsyncs the directory — :func:`atomic_write`
+    for writers that STREAM (an npz archive bigger than RAM headroom
+    must not be staged in memory first).  An exception unlinks the
+    temp file and leaves the destination untouched.
+
+    Streamed bytes bypass the ``faults.mutate_write`` chaos seam (it
+    needs the whole payload); whole-payload writers should use
+    :func:`atomic_write`."""
     path = os.fspath(path)
-    data = faults.mutate_write(path, data)
     d = os.path.dirname(os.path.abspath(path))
     # mkstemp creates 0600; a plain open(path, "wb") would have given
     # 0666&~umask (and overwriting keeps the old mode) — preserve that
@@ -138,7 +145,7 @@ def atomic_write(path: Union[str, os.PathLike], data: bytes,
                                suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            f.write(data)
+            yield f
             f.flush()
             os.fchmod(f.fileno(), mode)
             if durable:
@@ -156,6 +163,17 @@ def atomic_write(path: Union[str, os.PathLike], data: bytes,
             os.fsync(dfd)
         finally:
             os.close(dfd)
+
+
+def atomic_write(path: Union[str, os.PathLike], data: bytes,
+                 durable: bool = True) -> None:
+    """Crash-safe whole-file write: tmp file in the destination
+    directory -> flush -> fsync -> ``os.replace`` -> directory fsync.
+    ``durable=False`` skips the fsyncs (scratch files, tests)."""
+    path = os.fspath(path)
+    data = faults.mutate_write(path, data)
+    with atomic_writer(path, durable=durable) as f:
+        f.write(data)
 
 
 def read_file(path: Union[str, os.PathLike]) -> bytes:

@@ -143,7 +143,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "status": "degraded" if reg.poisoned else "ok",
                 "state": ps.state,
                 "model_version": reg.version,
-                "uptime_seconds": round(time.time() - ps.t0, 3),
+                "uptime_seconds": round(time.perf_counter() - ps.t0, 3),
                 "queue_rows": self.server.batcher.queued_rows,
                 "inflight": ps.inflight,
                 "buckets_compiled": reg.engine.num_compiled,
@@ -318,7 +318,9 @@ class PredictServer:
         self.metrics = metrics
         self.drain_grace = float(drain_grace)
         self.max_body_bytes = int(max_body_mb * (1 << 20))
-        self.t0 = time.time()           # /healthz uptime_seconds
+        # /healthz uptime_seconds: perf_counter — uptime is a duration,
+        # and an NTP step must not make it jump (XGT006)
+        self.t0 = time.perf_counter()
         self.state = "serving"          # serving -> draining -> stopped
         self._inflight = 0
         self._inflight_cv = threading.Condition()
