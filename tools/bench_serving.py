@@ -118,11 +118,19 @@ def main():
     warmup_s = time.perf_counter() - t0
     rng = np.random.RandomState(1)
 
+    # traversal-only latency comes from the unified registry's
+    # per-chunk histogram (engine times the margin launch into it) —
+    # snapshot the count so the bench reports only its own traffic
+    from xgboost_tpu.obs.metrics import predict_metrics
+    pm = predict_metrics()
+    chunk_n0 = pm.chunk_seconds.count
+
     c0 = engine.compile_count
     per_size = bench_direct(engine, rng)
     concurrent = bench_concurrent(engine, rng)
     assert engine.compile_count == c0, "steady state recompiled!"
 
+    desc = engine.describe()
     out = {
         "metric": "serving_1row_requests_per_sec",
         "value": per_size[1]["requests_per_sec"],
@@ -135,6 +143,18 @@ def main():
         "steady_state_compiles": engine.compile_count - c0,
         "per_request_rows": {str(k): v for k, v in per_size.items()},
         "concurrent": concurrent,
+        # device traversal time per tree chunk (xgbtpu_predict_chunk
+        # _seconds), separated from the request latency above — the
+        # queueing/transform/HTTP share is the difference
+        "traversal": {
+            "tree_chunk": desc["tree_chunk"],
+            "tree_chunks": desc["tree_chunks"],
+            "chunk_p50_ms": round(
+                pm.chunk_seconds.quantile(0.5) * 1e3, 3),
+            "chunk_p99_ms": round(
+                pm.chunk_seconds.quantile(0.99) * 1e3, 3),
+            "launches": pm.chunk_seconds.count - chunk_n0,
+        },
     }
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCH_serving.json")

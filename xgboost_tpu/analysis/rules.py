@@ -202,14 +202,17 @@ class HostSyncInHotLoop(Rule):
     ``np.asarray`` / ``device_get`` on a device value forces a blocking
     transfer per iteration, serializing the device pipeline (the exact
     cost class arXiv:1806.11248 §4 removes from the GPU hist method).
-    Scoped to the hot-path files; cold paths (save/load, dump) live
-    elsewhere or use comprehensions, which are not flagged.
+    Scoped to the hot-path files — including the serving engine, whose
+    warmup/chunking loops sit on the request path; cold paths
+    (save/load, dump) live elsewhere or use comprehensions, which are
+    not flagged.
     """
 
     code = "XGT002"
     name = "host-sync-in-hot-loop"
 
-    HOT_PATHS = ("models/gbtree.py", "models/updaters.py", "ops/")
+    HOT_PATHS = ("models/gbtree.py", "models/updaters.py", "ops/",
+                 "serving/engine.py")
 
     def applies(self, path: str) -> bool:
         return _path_has(path, self.HOT_PATHS)

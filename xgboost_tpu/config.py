@@ -106,6 +106,16 @@ class TrainParam:
 
     # -- gbtree params (reference src/gbm/gbtree-inl.hpp:389-428) --
     num_parallel_tree: int = 1
+    # chunked tree-parallel prediction (models/tree.py): how many trees
+    # traverse at once under vmap; the ensemble pads to the
+    # padded_tree_count ladder so one compilation serves every size in
+    # a chunk band.  -1 auto = 32 on TPU (batched compare-selects
+    # replace the per-tree chain of dependent level launches), scan on
+    # CPU (measured SLOWER there — tools/predict_microbench.py;
+    # PROFILE.md round 6); 0/1 = force the sequential scan baseline;
+    # >1 = force that chunk width.  XGBTPU_PREDICT_TREE_CHUNK env
+    # overrides for A/Bs.
+    predict_tree_chunk: int = -1
     # multi-root trees (reference TreeParam::num_roots, tree/param.h):
     # rows enter the tree at per-row roots given by the root_index meta
     # field (data.h:39-58); trees reserve ceil(log2 num_roots) top levels

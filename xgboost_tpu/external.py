@@ -388,7 +388,14 @@ def _prefetch_to_device(batches, depth: int = 2):
     ``depth`` batches ahead.  jax.device_put is thread-safe; the
     consumer's compute dispatches interleave with the worker's uploads
     on the host side, and the device runtime orders them on its stream.
-    Exceptions propagate to the consumer."""
+    Exceptions propagate to the consumer.
+
+    Shared upload/compute-overlap seam: paged training and prediction
+    consume it through :meth:`ExtMemDMatrix.device_batches`, and
+    ``Learner._bin_dense_blocked`` reuses it so row-block f32 uploads
+    of over-guard one-off predictions overlap the device quantize
+    (and the traversal that follows) instead of serializing through
+    the tunnel."""
     import queue
     import threading
 

@@ -729,7 +729,8 @@ class Booster:
         # add instead of a full-margin scatter per batch
         parts = [predict_margin_binned(
                      stack, group, batch, jnp.zeros((), jnp.float32),
-                     self.gbtree.cfg.max_depth, self._K)
+                     self.gbtree.cfg.max_depth, self._K,
+                     tree_chunk=self.gbtree.pred_chunk)
                  for _, batch in entry.dmat.device_batches()]
         entry.margin = jnp.asarray(entry.margin) + jnp.concatenate(parts)
         entry.applied = self.gbtree.num_trees
@@ -978,6 +979,52 @@ class Booster:
                 e.applied = 0
             self._sync_margin(entry)
 
+    def _bin_dense_blocked(self, data: DMatrix):
+        """Device-side quantization of a dense-enough matrix, chunked
+        over row blocks past the ``2^31``-byte single-buffer guard (a
+        20M x 28 one-off prediction used to silently fall back to the
+        seconds-long host ``searchsorted`` loop).
+
+        Row blocks densify straight from the CSR arrays — the host
+        working set is ONE f32 block, never a full N x F densify — and
+        are staged by :func:`external._prefetch_to_device`, so the f32
+        upload of block i+1 overlaps the quantize of block i instead of
+        serializing through the tunnel.  The block budget is 256 MB
+        (small against the guard, but thousands of rows even at wide F;
+        with the depth-2 prefetch queue at most ~4 blocks are in flight
+        device-side).  ``XGBTPU_BIN_BLOCK_BYTES`` overrides (test
+        seam)."""
+        from xgboost_tpu.binning import bin_dense_device
+        cv = self.gbtree.cuts.cut_values
+        Fm = self.gbtree.cuts.num_feature
+        N = data.num_row
+
+        def dense_block(s, e):
+            Xb = np.full((e - s, Fm), np.nan, np.float32)
+            lo, hi = data.indptr[s], data.indptr[e]
+            rows = np.repeat(np.arange(e - s),
+                             np.diff(data.indptr[s:e + 1]))
+            cols = data.indices[lo:hi]
+            keep = cols < Fm
+            Xb[rows[keep], cols[keep]] = data.values[lo:hi][keep]
+            return Xb
+
+        budget = int(os.environ.get("XGBTPU_BIN_BLOCK_BYTES", 0))
+        if not budget and N * Fm * 4 <= (1 << 31):
+            return bin_dense_device(dense_block(0, N), cv)
+        block = max(1, (budget or (1 << 28)) // (4 * max(Fm, 1)))
+        if N <= block:
+            return bin_dense_device(dense_block(0, N), cv)
+        from xgboost_tpu.external import _prefetch_to_device
+
+        def host_blocks():
+            for s in range(0, N, block):
+                yield s, dense_block(s, min(s + block, N))
+
+        parts = [bin_dense_device(xb, cv)
+                 for _, xb in _prefetch_to_device(host_blocks())]
+        return jnp.concatenate(parts, axis=0)
+
     # ------------------------------------------------------------ inference
     def predict(self, data: DMatrix, output_margin: bool = False,
                 ntree_limit: int = 0, pred_leaf: bool = False) -> np.ndarray:
@@ -991,6 +1038,17 @@ class Booster:
         assert self.gbtree is not None, "model not trained/loaded"
         if not hasattr(data, "num_row"):  # any DMatrix flavor has it
             data = DMatrix(np.asarray(data, dtype=np.float32))
+
+        def _counted(out):
+            """Attribute prediction traffic in /metrics by the rows
+            actually RETURNED: sharded ranks count their local shard
+            (num_row is the global count), and a predict that raises
+            counts nothing.  The serving engine feeds the same
+            family."""
+            if self.param.booster != "gblinear":
+                from xgboost_tpu.obs.metrics import predict_metrics
+                predict_metrics().rows.inc(out.shape[0])
+            return out
         if getattr(data, "is_sharded", False):
             # split-loaded matrix: each process returns predictions for
             # ITS OWN rows only (no host holds the full output)
@@ -1006,7 +1064,8 @@ class Booster:
                 entry = self._make_shard_loaded_entry(data)
             if pred_leaf:
                 leaves = self.gbtree.predict_leaf(entry.binned, ntree_limit)
-                return data.local_block_of(leaves)[:data.local_num_row]
+                return _counted(
+                    data.local_block_of(leaves)[:data.local_num_row])
             if ntree_limit == 0:
                 self._sync_margin(entry)
                 margin = entry.margin
@@ -1017,7 +1076,7 @@ class Booster:
                 margin, output_margin=output_margin))[:data.local_num_row]
             if out.ndim == 2 and out.shape[1] == 1:
                 out = out[:, 0]
-            return out
+            return _counted(out)
         cached = self._cache.get(id(data))
         if cached is None and getattr(data, "is_external", False):
             # one-off external prediction: build a transient entry WITHOUT
@@ -1029,7 +1088,7 @@ class Booster:
                 leaves = [np.asarray(self.gbtree.predict_leaf(
                     batch, ntree_limit))
                     for _, batch in data.device_batches()]
-                return np.concatenate(leaves, axis=0)
+                return _counted(np.concatenate(leaves, axis=0))
             if ntree_limit == 0:
                 self._sync_margin(cached)
                 margin = cached.margin
@@ -1044,7 +1103,7 @@ class Booster:
                 jnp.asarray(margin), output_margin=output_margin))
             if out.ndim == 2 and out.shape[1] == 1:
                 out = out[:, 0]
-            return out
+            return _counted(out)
         if cached is None:
             # one-off prediction: no cache registration (the reference's
             # buffer_offset = -1 path, learner-inl.hpp:332-346)
@@ -1057,23 +1116,21 @@ class Booster:
             elif getattr(self.gbtree, "exact_raw", False):
                 # exact mode routes on RAW values (no bins exist)
                 binned = self._raw_dense(data)[0]
-            elif (data.num_row * max(data.num_col, 1) * 4 <= (1 << 31)
-                  and len(data.values)
+            elif (len(data.values)
                       >= 0.25 * data.num_row * max(data.num_col, 1)):
                 # quantize ON DEVICE: the host searchsorted loop costs
                 # seconds at 1M rows where the fused compare-reduce is
-                # ~2 ms (binning.bin_dense_device); the f32 densify is
-                # the only host work left.  Sparse inputs (<25% dense)
-                # keep the O(nnz) bin_matrix path — densifying them
-                # host-side costs more memory/transfer than the device
-                # quantize saves (advisor, round 4)
-                from xgboost_tpu.binning import bin_dense_device
-                Fm = self.gbtree.cuts.num_feature
-                Xd = data.to_dense(missing=np.nan)[:, :Fm]
-                if Xd.shape[1] < Fm:
-                    Xd = np.pad(Xd, ((0, 0), (0, Fm - Xd.shape[1])),
-                                constant_values=np.nan)
-                binned = bin_dense_device(Xd, self.gbtree.cuts.cut_values)
+                # ~2 ms (binning.bin_dense_device); the per-block f32
+                # densify is the only host work left.  Sparse inputs
+                # (<25% dense) keep the O(nnz) bin_matrix path —
+                # densifying them host-side costs more memory/transfer
+                # than the device quantize saves (advisor, round 4).
+                # Matrices past the 2^31-byte single-buffer guard no
+                # longer cliff to the seconds-long host path: they
+                # quantize in CSR-densified row blocks (prefetch-
+                # staged, upload overlapping quantize, bounded host +
+                # device working set)
+                binned = self._bin_dense_blocked(data)
             else:
                 binned = jnp.asarray(bin_matrix(data, self.gbtree.cuts))
             base = self._base_margin_of(data, data.num_row)
@@ -1090,7 +1147,8 @@ class Booster:
         if pred_leaf:
             leaves = np.asarray(self._replicated(
                 self.gbtree.predict_leaf(binned, ntree_limit, root=root)))
-            return cached.user_rows(leaves) if cached is not None else leaves
+            return _counted(cached.user_rows(leaves)
+                            if cached is not None else leaves)
         if cached is not None and ntree_limit == 0:
             self._sync_margin(cached)
             margin = cached.margin
@@ -1103,7 +1161,7 @@ class Booster:
             out = cached.user_rows(out)
         if out.ndim == 2 and out.shape[1] == 1:
             out = out[:, 0]
-        return out
+        return _counted(out)
 
     # ----------------------------------------------------------- evaluation
     def _metrics(self, feval=None) -> List:

@@ -164,6 +164,19 @@ class GBTree:
         self.cut_values_dev = jnp.asarray(cuts.cut_values)
         self.n_cuts_dev = jnp.asarray(cuts.n_cuts)
         self._col_pad_cache = None  # (n_shard, cut_values, n_cuts)
+        # chunked tree-parallel traversal width (models/tree.py); 0/1 =
+        # the sequential scan baseline; -1 auto = 32 on TPU, scan on
+        # CPU (the batched compare-select kernel loses to the scan's
+        # cache locality there — tools/predict_microbench.py,
+        # PROFILE.md round 6).  The env override is the A/B seam.
+        env_chunk = os.environ.get("XGBTPU_PREDICT_TREE_CHUNK")
+        if env_chunk not in (None, ""):
+            self.pred_chunk = max(0, int(env_chunk))
+        else:
+            pc = int(param.predict_tree_chunk)
+            if pc < 0:
+                pc = 32 if jax.default_backend() == "tpu" else 0
+            self.pred_chunk = pc
 
     @property
     def trees(self) -> List[TreeArrays]:
@@ -682,7 +695,8 @@ class GBTree:
                                       self.cfg.max_depth, K)
         return predict_margin_binned(
             stack, group, binned, base, self.cfg.max_depth, K,
-            root=root, n_roots=self.cfg.n_roots)
+            root=root, n_roots=self.cfg.n_roots,
+            tree_chunk=self.pred_chunk)
 
     def predict_incremental(self, binned: jax.Array, margin: jax.Array,
                             new_trees: List[TreeArrays],
@@ -708,7 +722,8 @@ class GBTree:
         return predict_margin_binned(
             stack, group, binned, jnp.zeros((), jnp.float32),
             self.cfg.max_depth, K,
-            root=root, n_roots=self.cfg.n_roots) + margin
+            root=root, n_roots=self.cfg.n_roots,
+            tree_chunk=self.pred_chunk) + margin
 
     def predict_leaf(self, binned: jax.Array, ntree_limit: int = 0,
                      root: Optional[jax.Array] = None) -> jax.Array:
@@ -721,7 +736,8 @@ class GBTree:
             _, leaves = jax.lax.scan(body, None, stack)
             return leaves.T
         return predict_leaf_binned(stack, binned, self.cfg.max_depth,
-                                   root=root, n_roots=self.cfg.n_roots)
+                                   root=root, n_roots=self.cfg.n_roots,
+                                   tree_chunk=self.pred_chunk)
 
     # ------------------------------------------------------------ serialize
     def get_state(self) -> dict:

@@ -591,18 +591,89 @@ def _traverse_one(tree: TreeArrays, binned: jax.Array, max_depth: int,
     return leaf_node
 
 
+def padded_tree_count(T: int, tree_chunk: int) -> int:
+    """Padded ensemble size of the chunked traversal for ``T`` trees.
+
+    The ladder bounds compilation count for GROWING ensembles while
+    keeping padded-tree waste near zero for the small stacks the
+    incremental per-round margin update traverses:
+
+      - ``T <= tree_chunk``: next power of two >= T, capped at
+        ``tree_chunk`` (a 1-tree round update pads to 1, not to a full
+        chunk; the cap keeps a non-power-of-two chunk's promised vmap
+        width — T=12 at chunk 12 pads to 12, not 16);
+      - ``T > tree_chunk``: next multiple of ``tree_chunk``.
+
+    Distinct padded sizes for T in [1, k*chunk] total at most
+    ``log2(chunk) + k`` — the fixed compile budget the bounded-compile
+    test pins (tests/test_predict_chunk.py)."""
+    if tree_chunk <= 1:
+        return T
+    if T <= tree_chunk:
+        return min(1 << max(T - 1, 0).bit_length(), tree_chunk)
+    return -(-T // tree_chunk) * tree_chunk
+
+
+def predict_chunk_layout(T: int, tree_chunk: int):
+    """(T_padded, chunk_size, n_chunks) of the chunked traversal —
+    shared by the traversal itself and by serving/observability code
+    attributing per-chunk cost.  Below the chunk the whole (power-of-
+    two-padded, chunk-capped) ensemble is one chunk."""
+    if tree_chunk <= 1:
+        return T, 1, T
+    T_pad = padded_tree_count(T, tree_chunk)
+    C = T_pad if T <= tree_chunk else tree_chunk
+    return T_pad, C, T_pad // C
+
+
+def pad_predict_stack(stack: TreeArrays, tree_group: jax.Array,
+                      tree_chunk: int):
+    """Pad a (T, ...) ensemble stack to the :func:`padded_tree_count`
+    ladder with zero-leaf-value trees (feature -1 = immediate leaf at
+    the root, contributing exactly 0 — and the traversal core skips
+    them via ``n_valid`` anyway).
+
+    Returns ``(stack_padded, group_padded, n_valid)``.  This is EAGER
+    glue deliberately kept OUTSIDE the jitted traversal core: padding
+    inside the jit would key the compiled program on the raw T and
+    recompile the whole traversal per ensemble size; out here, growing
+    T costs only byte-copy concat ops while the heavy program compiles
+    once per ladder rung (tests/test_predict_chunk.py pins the
+    budget)."""
+    T = int(stack.feature.shape[0])
+    T_pad = padded_tree_count(T, tree_chunk)
+    if T_pad == T:
+        return stack, tree_group, T
+
+    def pad(x, fill=0):
+        return jnp.concatenate(
+            [x, jnp.full((T_pad - T,) + x.shape[1:], fill, x.dtype)])
+    stack = stack._replace(
+        **{f: pad(getattr(stack, f), -1 if f == "feature" else 0)
+           for f in TreeArrays._fields})
+    return stack, pad(tree_group), T
+
+
+def _chunk_leaves(chunk: TreeArrays, binned, max_depth, root, n_roots):
+    """(C, N) leaf indices of one tree chunk: ``_traverse_one`` vmapped
+    over the tree axis.  The per-level one-hot compares batch into
+    (C, N, 2^d) fused compare-select-sums — the same lowering that made
+    vmapped ensemble GROWTH beat sequential launches (PROFILE.md round
+    3: table_lookup's custom_vmap rule; 6-tree growth 305 -> 70 ms)."""
+    return jax.vmap(
+        lambda tr: _traverse_one(tr, binned, max_depth, root, n_roots)
+    )(chunk)
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_group",
                                              "n_roots"))
-def predict_margin_binned(stack: TreeArrays, tree_group: jax.Array,
-                          binned: jax.Array, base: jax.Array,
-                          max_depth: int, n_group: int,
-                          root: Optional[jax.Array] = None,
-                          n_roots: int = 1) -> jax.Array:
-    """Sum of leaf values over a (T, n_nodes) stacked ensemble.
-
-    Scanned over trees so one compilation serves any ensemble size with
-    the same (N, n_nodes) shapes.  Returns (N, n_group) margins.
-    """
+def _predict_margin_scan(stack: TreeArrays, tree_group: jax.Array,
+                         binned: jax.Array, base: jax.Array,
+                         max_depth: int, n_group: int,
+                         root: Optional[jax.Array] = None,
+                         n_roots: int = 1) -> jax.Array:
+    """Sequential ``lax.scan`` over trees — the pre-chunking traversal,
+    kept as the A/B baseline and the ``tree_chunk<=1`` path."""
     N = binned.shape[0]
 
     def body(margin, tg):
@@ -618,13 +689,134 @@ def predict_margin_binned(stack: TreeArrays, tree_group: jax.Array,
     return margin
 
 
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_group",
+                                             "n_roots", "tree_chunk"))
+def _predict_margin_chunked(stack: TreeArrays, tree_group: jax.Array,
+                            n_valid: jax.Array, binned: jax.Array,
+                            base: jax.Array, max_depth: int, n_group: int,
+                            root: Optional[jax.Array], n_roots: int,
+                            tree_chunk: int) -> jax.Array:
+    """Chunked tree-parallel traversal core.  ``stack`` is ALREADY
+    padded to a ``tree_chunk`` multiple (:func:`pad_predict_stack`), so
+    the compiled program is keyed on the ladder rung, not the raw
+    ensemble size; ``n_valid`` (the real tree count) is a TRACED
+    scalar, so growing within a rung never retraces.
+
+    Bit-identity with the scan: contributions accumulate IN TREE ORDER
+    through the same ``margin + contrib * one_hot`` expression (the
+    per-tree one-hot compare-selects are exact — a single nonzero term
+    summed over zeros), and padded trees leave the margin untouched via
+    ``where(valid, updated, margin)`` rather than adding 0.0 (which
+    would flip a -0.0 margin cell to +0.0)."""
+    N = binned.shape[0]
+    T_pad = stack.feature.shape[0]
+    C = tree_chunk                 # layout-derived; always divides T_pad
+    n_chunks = T_pad // C
+    margin = jnp.broadcast_to(base, (N, n_group)).astype(jnp.float32)
+
+    chunks = jax.tree.map(
+        lambda x: x.reshape((n_chunks, C) + x.shape[1:]), stack)
+    groups = tree_group.reshape(n_chunks, C)
+    valid = (jnp.arange(T_pad, dtype=jnp.int32)
+             < n_valid).reshape(n_chunks, C)
+
+    def body(m, cgv):
+        chunk, gs, vs = cgv
+        leaves = _chunk_leaves(chunk, binned, max_depth, root, n_roots)
+        contribs = jax.vmap(table_lookup)(chunk.leaf_value, leaves)
+
+        def acc(mm, tgv):
+            contrib, group, ok = tgv
+            upd = mm + contrib[:, None] * jax.nn.one_hot(
+                group, n_group, dtype=mm.dtype)
+            return jnp.where(ok, upd, mm), None
+        m, _ = jax.lax.scan(acc, m, (contribs, gs, vs))
+        return m, None
+
+    margin, _ = jax.lax.scan(body, margin, (chunks, groups, valid))
+    return margin
+
+
+def predict_margin_binned(stack: TreeArrays, tree_group: jax.Array,
+                          binned: jax.Array, base: jax.Array,
+                          max_depth: int, n_group: int,
+                          root: Optional[jax.Array] = None,
+                          n_roots: int = 1,
+                          tree_chunk: int = 0) -> jax.Array:
+    """Sum of leaf values over a (T, n_nodes) stacked ensemble.
+
+    ``tree_chunk > 1`` selects the chunked TREE-PARALLEL traversal:
+    the ensemble pads to the :func:`padded_tree_count` ladder with
+    zero-leaf-value trees, ``tree_chunk`` trees traverse at once under
+    ``vmap`` (each level one batched compare-select instead of a
+    per-tree chain of dependent launches — the PROFILE.md round-3
+    vmapped-growth result applied to inference), and per-tree leaf
+    contributions reduce into the (N, n_group) margin in tree order —
+    bit-identical to the sequential scan (tests/test_predict_chunk.py).
+    One compilation serves every ensemble size on the same ladder rung
+    (``recompile_guard``-enforced).
+
+    ``tree_chunk <= 1`` keeps the original scan over trees
+    (``XGBTPU_PREDICT_TREE_CHUNK=0`` forces it end to end).  Returns
+    (N, n_group) margins.
+    """
+    if tree_chunk <= 1:
+        return _predict_margin_scan(stack, tree_group, binned, base,
+                                    max_depth, n_group, root, n_roots)
+    _, C, _ = predict_chunk_layout(int(stack.feature.shape[0]),
+                                   tree_chunk)
+    stack, tree_group, n_valid = pad_predict_stack(stack, tree_group,
+                                                   tree_chunk)
+    return _predict_margin_chunked(stack, tree_group, jnp.int32(n_valid),
+                                   binned, base, max_depth, n_group,
+                                   root, n_roots, C)
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_roots"))
-def predict_leaf_binned(stack: TreeArrays, binned: jax.Array,
-                        max_depth: int, root: Optional[jax.Array] = None,
-                        n_roots: int = 1) -> jax.Array:
-    """(N, T) leaf node index per tree (reference PredictLeaf,
-    gbtree-inl.hpp:355-385)."""
+def _predict_leaf_scan(stack: TreeArrays, binned: jax.Array,
+                       max_depth: int, root: Optional[jax.Array] = None,
+                       n_roots: int = 1) -> jax.Array:
     def body(_, tree):
         return None, _traverse_one(tree, binned, max_depth, root, n_roots)
     _, leaves = jax.lax.scan(body, None, stack)
     return leaves.T
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_roots",
+                                             "tree_chunk"))
+def _predict_leaf_chunked(stack: TreeArrays, binned: jax.Array,
+                          max_depth: int, root: Optional[jax.Array],
+                          n_roots: int, tree_chunk: int) -> jax.Array:
+    """(T_pad, N) leaves of a padded stack, chunked like the margin
+    core (padded columns are sliced off by the caller)."""
+    T_pad = stack.feature.shape[0]
+    C = tree_chunk                 # layout-derived; always divides T_pad
+    n_chunks = T_pad // C
+    chunks = jax.tree.map(
+        lambda x: x.reshape((n_chunks, C) + x.shape[1:]), stack)
+
+    def body(_, chunk):
+        return None, _chunk_leaves(chunk, binned, max_depth, root,
+                                   n_roots)
+    _, leaves = jax.lax.scan(body, None, chunks)     # (n_chunks, C, N)
+    return leaves.reshape(T_pad, -1)
+
+
+def predict_leaf_binned(stack: TreeArrays, binned: jax.Array,
+                        max_depth: int, root: Optional[jax.Array] = None,
+                        n_roots: int = 1,
+                        tree_chunk: int = 0) -> jax.Array:
+    """(N, T) leaf node index per tree (reference PredictLeaf,
+    gbtree-inl.hpp:355-385).  ``tree_chunk > 1`` traverses chunks of
+    trees in parallel (same ladder/padding as
+    :func:`predict_margin_binned`); leaf indices are integers, so
+    parity with the scan is trivial."""
+    if tree_chunk <= 1:
+        return _predict_leaf_scan(stack, binned, max_depth, root, n_roots)
+    T = int(stack.feature.shape[0])
+    _, C, _ = predict_chunk_layout(T, tree_chunk)
+    group = jnp.zeros(T, jnp.int32)          # layout only; groups unused
+    stack, _, _ = pad_predict_stack(stack, group, tree_chunk)
+    leaves = _predict_leaf_chunked(stack, binned, max_depth, root,
+                                   n_roots, C)
+    return leaves[:T].T

@@ -32,8 +32,9 @@ from xgboost_tpu.obs.events import (EventLog, configure_log,  # noqa: F401
 from xgboost_tpu.obs.metrics import (Counter, Gauge,  # noqa: F401
                                      Histogram, LabeledCounter,
                                      LabeledGauge, MetricsRegistry,
-                                     ReliabilityMetrics, ServingMetrics,
-                                     TrainingMetrics, registry,
+                                     PredictMetrics, ReliabilityMetrics,
+                                     ServingMetrics, TrainingMetrics,
+                                     predict_metrics, registry,
                                      reliability_metrics,
                                      training_metrics)
 from xgboost_tpu.obs.profiler import RoundProfiler  # noqa: F401
@@ -62,6 +63,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "LabeledCounter", "LabeledGauge",
     "MetricsRegistry", "registry",
     "ServingMetrics", "ReliabilityMetrics", "TrainingMetrics",
+    "PredictMetrics", "predict_metrics",
     "reliability_metrics", "training_metrics",
     "RoundProfiler",
     "start_metrics_server", "get_metrics_server", "stop_metrics_server",

@@ -477,6 +477,45 @@ def training_metrics() -> TrainingMetrics:
     return _TRAINING
 
 
+# ---------------------------------------------------------------- predict
+class PredictMetrics:
+    """Prediction-path metric group (``xgbtpu_predict_*``): attributes
+    the chunked tree-parallel traversal (models/tree.py) in /metrics.
+    One instance per process (:func:`predict_metrics`), fed by
+    ``Learner.predict`` and the serving ``PredictEngine``; rendered into
+    every scrape via the registry."""
+
+    def __init__(self, prefix: str = "xgbtpu_predict"):
+        p = prefix
+        self.rows = Counter(
+            f"{p}_rows_total",
+            "rows predicted through the gbtree traversal "
+            "(Learner.predict + serving engine)")
+        self.chunk_seconds = Histogram(
+            f"{p}_chunk_seconds",
+            "device traversal wall seconds per tree chunk "
+            "(margin launch time / chunk count)", _LATENCY_BUCKETS)
+        self._all = (self.rows, self.chunk_seconds)
+        registry().register("predict", self.render)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._all)
+
+
+_PREDICT: Optional[PredictMetrics] = None
+_PREDICT_LOCK = threading.Lock()
+
+
+def predict_metrics() -> PredictMetrics:
+    """The process-wide PredictMetrics singleton."""
+    global _PREDICT
+    if _PREDICT is None:
+        with _PREDICT_LOCK:
+            if _PREDICT is None:
+                _PREDICT = PredictMetrics()
+    return _PREDICT
+
+
 # ----------------------------------------------------------------- serving
 class ServingMetrics:
     """Metric registry for the serving subsystem (see SERVING.md for the

@@ -18,6 +18,7 @@ padded batch are bit-identical to ``Learner.predict`` on the same rows
 from __future__ import annotations
 
 import threading
+import time as _time
 from bisect import bisect_left
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -103,13 +104,23 @@ class PredictEngine:
         self._base_cache: Dict[int, object] = {}  # bucket rows -> (B, K) base
         self._lock = threading.Lock()
         # device-resident model state, uploaded once
+        import jax
         import jax.numpy as jnp
         self._stack, self._group = self.gbtree._stack(0)
         self._bin_dtype = (np.uint8 if self.cuts.max_bin <= 256
                            else np.uint16)
         self._base_scalar = float(
             self.obj.prob_to_margin(booster.param.base_score))
-        self._jnp = jnp
+        self._jax, self._jnp = jax, jnp
+        # chunked tree-parallel traversal layout (models/tree.py): the
+        # serving T is fixed, so the chunk count is a constant of the
+        # engine — recorded on serve.predict spans and used to
+        # attribute per-chunk traversal seconds in /metrics
+        from xgboost_tpu.models.tree import predict_chunk_layout
+        self._tree_chunk = self.gbtree.pred_chunk
+        _, _, self._n_chunks = predict_chunk_layout(
+            int(self._stack.feature.shape[0]), max(self._tree_chunk, 1))
+        self._warming = False
         if warmup:
             self.warmup()
 
@@ -124,10 +135,12 @@ class PredictEngine:
     def _margin_fn(self):
         from xgboost_tpu.models.tree import predict_margin_binned
         max_depth, K, n_roots = self._max_depth, self._K, self._n_roots
+        tree_chunk = self._tree_chunk
 
         def fn(stack, group, binned, base):
             return predict_margin_binned(stack, group, binned, base,
-                                         max_depth, K, n_roots=n_roots)
+                                         max_depth, K, n_roots=n_roots,
+                                         tree_chunk=tree_chunk)
         return fn
 
     def _executable(self, bucket: int):
@@ -171,6 +184,7 @@ class PredictEngine:
         ``compiles_total`` still counts (it is the warmup's product)."""
         F = self.cuts.num_feature
         saved, self.metrics = self.metrics, None
+        self._warming = True
         c0 = self.compile_count
         try:
             for b in self.buckets:
@@ -179,6 +193,7 @@ class PredictEngine:
                              output_margin=True)
         finally:
             self.metrics = saved
+            self._warming = False
             if saved is not None and self.compile_count > c0:
                 saved.compiles.inc(self.compile_count - c0)
 
@@ -218,12 +233,32 @@ class PredictEngine:
             self.metrics.padded_rows.inc(bucket - n)
         # the innermost serving span: the device margin computation,
         # nested under serve.batch -> serve.request when the event log
-        # is on (a no-op otherwise)
+        # is on (a no-op otherwise).  The executable is resolved BEFORE
+        # the timed region (a first-touch bucket compile would dwarf
+        # every real traversal sample), and the launch is blocked on so
+        # the per-chunk histogram measures device time, not async
+        # dispatch — the transform right after would sync here anyway.
+        # Warmup traffic is suppressed like the ServingMetrics row
+        # counters (phantom rows + warm-path cache effects).
         from xgboost_tpu.obs import span
-        with span("serve.predict", rows=n, bucket=bucket):
-            margin = self._executable(bucket)(
-                self._stack, self._group, self._jnp.asarray(binned),
-                self._base_for(bucket))
+        from xgboost_tpu.obs.metrics import predict_metrics
+        pm = None if self._warming else predict_metrics()
+        exe = self._executable(bucket)
+        # the batch upload stays OUTSIDE the timed region too: the
+        # histogram must attribute TRAVERSAL, not transfer (the cost
+        # split this round exists to pin)
+        binned_dev = self._jnp.asarray(binned)
+        with span("serve.predict", rows=n, bucket=bucket,
+                  chunk=self._tree_chunk, chunks=self._n_chunks):
+            t0 = _time.perf_counter()
+            margin = exe(self._stack, self._group, binned_dev,
+                         self._base_for(bucket))
+            self._jax.block_until_ready(margin)
+            if pm is not None:
+                pm.chunk_seconds.observe(
+                    (_time.perf_counter() - t0) / max(self._n_chunks, 1))
+        if pm is not None:
+            pm.rows.inc(n)
         # the transform runs OUTSIDE the compiled margin executable, via
         # the objective's own (row-independent) ops — the exact functions
         # Learner.predict dispatches, so rounding matches bit for bit
@@ -257,4 +292,6 @@ class PredictEngine:
             "num_feature": self.num_feature,
             "num_trees": self.gbtree.num_trees,
             "objective": self.booster.param.objective,
+            "tree_chunk": self._tree_chunk,
+            "tree_chunks": self._n_chunks,
         }
