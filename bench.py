@@ -99,22 +99,31 @@ def _time_training(xgb, params, d, rounds):
     return dt, bst
 
 
-def _time_predict(bst, make_dmat, n_rows):
+def _time_predict(bst, make_input, n_rows):
     """Best-of-reps one-off prediction timing (predict returns a host
-    numpy array, so the pull is the barrier).  A FRESH DMatrix per rep
-    exercises the uncached path — device-side quantization + level-
-    local traversal (the round-4 fast paths this guards; reference
-    headline harness times the full train+predict cycle,
-    demo/kaggle-higgs/speedtest.py:44-60)."""
-    bst.predict(make_dmat())                     # warm the jit caches
+    numpy array, so the pull is the barrier).  A FRESH input per rep
+    exercises the uncached path — round 7: raw f32 ndarray inputs ride
+    the direct-buffer + fused quantize+traverse pipeline (upload
+    overlapped block-wise, binned matrix never materialized), the
+    serving-realistic shape of one-off scoring.  Also returns the
+    measured host→device transfer rate from the round-7 counters
+    (``predict_transfer_mb_per_sec``) so the transfer wall is pinned
+    separately from end-to-end rows/s."""
+    from xgboost_tpu.obs.metrics import predict_metrics
+    bst.predict(make_input())                    # warm the jit caches
+    pm = predict_metrics()
     dt = float("inf")
+    b0, s0 = pm.transfer_bytes.value, pm.transfer_seconds.sum
     for _ in range(int(os.environ.get("BENCH_REPS", 3))):
-        d = make_dmat()
+        d = make_input()
         t0 = time.perf_counter()
         p = bst.predict(d)
         dt = min(dt, time.perf_counter() - t0)
         assert p.shape[0] == n_rows
-    return n_rows / dt
+    db = pm.transfer_bytes.value - b0
+    ds = pm.transfer_seconds.sum - s0
+    mbps = (db / 1e6 / ds) if ds > 0 else 0.0
+    return n_rows / dt, mbps
 
 
 def _time_predict_binned(bst, binned, n_rows):
@@ -161,8 +170,8 @@ def bench_multiclass():
     dt, bst = _time_training(xgb, params, d, rounds)
     pred = bst.predict(dte)
     merror = float((pred != y[n:]).mean())
-    pred_rps = _time_predict(
-        bst, lambda: xgb.DMatrix(X[:n]), n)
+    pred_rps, _ = _time_predict(
+        bst, lambda: np.ascontiguousarray(X[:n]), n)
     pred_binned_rps = _time_predict_binned(
         bst, bst._cache[id(d)].binned, n)
     return dt / (rounds - 1) * 1e3, merror, pred_rps, pred_binned_rps
@@ -349,14 +358,17 @@ def main():
                 measured = json.load(f).get("baseline_1m", {})
             baseline_rows_per_sec = measured.get("rows_per_sec_1thread",
                                                  baseline_rows_per_sec)
-        # one-off 100-tree prediction on the full training shape (the
-        # round-4 prediction fast paths: device quantize + level-local
-        # traversal) — driver-visible so they can't silently regress.
-        # predict_binned_rows_per_sec strips quantize + upload: it times
-        # ONLY the chunked tree-parallel traversal on the cached binned
-        # matrix, so the traversal win/regression is pinned separately
-        # from the (transfer-bound on this host) uncached number
-        pred_rps = _time_predict(bst, lambda: xgb.DMatrix(Xtr), n_rows)
+        # one-off 100-tree prediction on the full training shape —
+        # driver-visible so the prediction fast paths can't silently
+        # regress.  predict_binned_rows_per_sec strips quantize + upload
+        # (traversal only, cached binned matrix); the round-7 fields pin
+        # the transfer wall itself: predict_transfer_mb_per_sec is the
+        # measured upload rate from the xgbtpu_predict_transfer_*
+        # counters and predict_gap_ratio = uncached/traversal-only
+        # rows/s (1.0 = the transfer wall is gone; ROADMAP's success
+        # metric for the round-7 work)
+        pred_rps, transfer_mbps = _time_predict(
+            bst, lambda: np.ascontiguousarray(Xtr), n_rows)
         pred_binned_rps = _time_predict_binned(
             bst, bst._cache[id(dtrain)].binned, n_rows)
         out = {
@@ -367,6 +379,8 @@ def main():
             "vs_baseline": round(rows_per_sec / baseline_rows_per_sec, 2),
             "predict_rows_per_sec": round(pred_rps, 1),
             "predict_binned_rows_per_sec": round(pred_binned_rps, 1),
+            "predict_transfer_mb_per_sec": round(transfer_mbps, 1),
+            "predict_gap_ratio": round(pred_rps / pred_binned_rps, 4),
         }
     if "multiclass" in workloads:
         mc_ms, mc_err, mc_prps, mc_bprps = bench_multiclass()
@@ -374,6 +388,7 @@ def main():
         out["multiclass_merror"] = round(mc_err, 4)
         out["multiclass_predict_rows_per_sec"] = round(mc_prps, 1)
         out["multiclass_predict_binned_rows_per_sec"] = round(mc_bprps, 1)
+        out["multiclass_predict_gap_ratio"] = round(mc_prps / mc_bprps, 4)
     if "rank" in workloads:
         rk_rps, rk_ndcg = bench_rank()
         out["rank_rounds_per_sec"] = round(rk_rps, 2)

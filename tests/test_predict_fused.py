@@ -1,0 +1,577 @@
+"""Round-7 transfer-wall stack: fused quantize+traverse, direct-buffer
+uploads, the double-buffered upload seam, and the device-resident
+feature store (serving/featurestore.py).
+
+Parity suite: the fused program must be BIT-identical to the two-step
+quantize-then-traverse path across block boundaries, N not a block
+multiple, multiclass, and ntree_limit windows; the feature store must
+gather exactly the rows that were put, evict in LRU order under its
+byte budget, and survive a registry hot-reload by rebinning resident
+raw rows against the new model's cuts.  A ``recompile_guard`` budget
+pins the fused program ladder.  (No mesh usage — no AxisType gate.)
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+def _train(params=None, n=400, f=8, rounds=7, seed=0, num_class=0):
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    X[::11, f // 2] = np.nan                      # missing -> bin 0
+    if num_class:
+        y = (np.nan_to_num(X[:, 0]) * num_class).astype(np.int64) \
+            % num_class
+        y = y.astype(np.float32)
+        p = {"objective": "multi:softmax", "num_class": num_class}
+    else:
+        y = (np.nan_to_num(X[:, 0])
+             + 0.3 * np.nan_to_num(X[:, 1]) > 0.6).astype(np.float32)
+        p = {"objective": "binary:logistic"}
+    p.update({"max_depth": 4, "eta": 0.3, "silent": 1})
+    p.update(params or {})
+    d = xgb.DMatrix(X, label=y)
+    return xgb.train(p, d, rounds), X, d
+
+
+def _two_step(bst, X):
+    """Reference margins via the explicit two-step path."""
+    import jax.numpy as jnp
+    from xgboost_tpu.binning import bin_dense_device
+    binned = bin_dense_device(X, bst.gbtree.cuts.cut_values)
+    return np.asarray(bst.gbtree.predict_margin(
+        binned, jnp.zeros((), jnp.float32)))
+
+
+# ------------------------------------------------------------- fused core
+def test_fused_margin_bit_parity():
+    """predict_margin_fused == quantize-then-predict_margin, for the
+    scan baseline AND a chunked layout, including NaN rows."""
+    import jax.numpy as jnp
+    bst, X, _ = _train(rounds=7)
+    gbt = bst.gbtree
+    ref = _two_step(bst, X)
+    Xd = jnp.asarray(X)
+    for chunk in (0, 4):
+        saved = gbt.pred_chunk
+        gbt.pred_chunk = chunk
+        try:
+            fused = np.asarray(gbt.predict_margin_fused(
+                Xd, jnp.zeros((), jnp.float32)))
+        finally:
+            gbt.pred_chunk = saved
+        assert np.array_equal(ref, fused), chunk
+
+
+def test_fused_ntree_limit_windows():
+    import jax.numpy as jnp
+    bst, X, _ = _train(rounds=9)
+    gbt = bst.gbtree
+    Xd = jnp.asarray(X)
+    from xgboost_tpu.binning import bin_dense_device
+    binned = bin_dense_device(Xd, gbt.cuts.cut_values)
+    for lim in (1, 3, 5, 9):
+        ref = np.asarray(gbt.predict_margin(
+            binned, jnp.zeros((), jnp.float32), lim))
+        fused = np.asarray(gbt.predict_margin_fused(
+            Xd, jnp.zeros((), jnp.float32), lim))
+        assert np.array_equal(ref, fused), lim
+
+
+def test_learner_fused_vs_two_step_end_to_end(monkeypatch):
+    """Booster.predict with the fused default equals the
+    XGBTPU_PREDICT_FUSED=0 two-step baseline — including a blocked run
+    where N is NOT a block multiple (block boundaries are invisible)."""
+    import xgboost_tpu as xgb
+    bst, X, _ = _train(rounds=5, n=501, f=6)     # 501: not a multiple
+    ref = bst.predict(xgb.DMatrix(X))            # fused default
+    monkeypatch.setenv("XGBTPU_PREDICT_FUSED", "0")
+    two = bst.predict(xgb.DMatrix(X))
+    monkeypatch.delenv("XGBTPU_PREDICT_FUSED")
+    assert np.array_equal(ref, two)
+    # ~4 blocks, last one ragged
+    monkeypatch.setenv("XGBTPU_BIN_BLOCK_BYTES", str(501 * 6 * 4 // 4))
+    assert np.array_equal(ref, bst.predict(xgb.DMatrix(X)))
+    # the direct-buffer satellite: a C-contiguous f32 ndarray skips the
+    # CSR round-trip and uploads the caller's own blocks
+    assert np.array_equal(ref, bst.predict(X))
+    assert np.array_equal(ref, bst.predict(np.asfortranarray(X)))
+
+
+def test_learner_fused_multiclass_and_base_margin(monkeypatch):
+    import xgboost_tpu as xgb
+    bst, X, _ = _train(rounds=4, num_class=3)
+    ref = bst.predict(xgb.DMatrix(X))
+    monkeypatch.setenv("XGBTPU_PREDICT_FUSED", "0")
+    assert np.array_equal(ref, bst.predict(xgb.DMatrix(X)))
+    monkeypatch.delenv("XGBTPU_PREDICT_FUSED")
+    # a user base_margin rides the per-block slices bit-identically
+    bm = np.linspace(-1, 1, X.shape[0] * 3).astype(np.float32)
+    d1 = xgb.DMatrix(X)
+    d1.set_base_margin(bm)
+    ref_bm = bst.predict(d1, output_margin=True)
+    monkeypatch.setenv("XGBTPU_PREDICT_FUSED", "0")
+    d2 = xgb.DMatrix(X)
+    d2.set_base_margin(bm)
+    assert np.array_equal(ref_bm, bst.predict(d2, output_margin=True))
+
+
+def test_upload_depth_seam(monkeypatch):
+    """XGBTPU_PREDICT_UPLOAD_DEPTH in {0 sync, 1, 2} is value-invisible
+    on a blocked fused prediction."""
+    import xgboost_tpu as xgb
+    bst, X, _ = _train(rounds=3, n=300, f=6)
+    monkeypatch.setenv("XGBTPU_BIN_BLOCK_BYTES", str(300 * 6 * 4 // 3))
+    ref = bst.predict(xgb.DMatrix(X))
+    for depth in ("0", "1", "2"):
+        monkeypatch.setenv("XGBTPU_PREDICT_UPLOAD_DEPTH", depth)
+        assert np.array_equal(ref, bst.predict(xgb.DMatrix(X))), depth
+
+
+def test_transfer_counters_account_uploads():
+    """Every one-off predict upload lands on
+    xgbtpu_predict_transfer_{bytes_total,seconds}."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu.obs.metrics import predict_metrics
+    bst, X, _ = _train(rounds=2, n=200, f=6)
+    pm = predict_metrics()
+    b0, n0 = pm.transfer_bytes.value, pm.transfer_seconds.count
+    bst.predict(xgb.DMatrix(X))
+    assert pm.transfer_bytes.value - b0 == X.nbytes
+    assert pm.transfer_seconds.count > n0
+
+
+def test_fused_compile_budget(recompile_guard):
+    """Growing T = 1..3*chunk through the FUSED program compiles once
+    per ladder rung (same budget as the two-step traversal), and a
+    second pass compiles nothing."""
+    import jax
+    import jax.numpy as jnp
+    from xgboost_tpu.models.tree import (pad_predict_stack,
+                                         padded_tree_count,
+                                         predict_margin_fused)
+    bst, X, _ = _train(rounds=12)
+    chunk = 4
+    stack, group = bst.gbtree._stack(0)
+    cuts = bst.gbtree.cut_values_dev
+    Xd = jnp.asarray(X)
+    base = jnp.zeros((), jnp.float32)
+    windows = []
+    for T in range(1, 13):
+        win = (jax.tree.map(lambda x: x[:T], stack), group[:T])
+        windows.append(win)
+        jax.block_until_ready(pad_predict_stack(win[0], win[1],
+                                                chunk)[:2])
+    expected = len({padded_tree_count(T, chunk) for T in range(1, 13)})
+    assert expected == 5  # {1, 2, 4, 8, 12}
+    with recompile_guard.expect(expected):
+        for st, gr in windows:
+            jax.block_until_ready(predict_margin_fused(
+                st, gr, Xd, cuts, base, 4, 1, tree_chunk=chunk))
+    with recompile_guard.expect(0):
+        for st, gr in windows:
+            jax.block_until_ready(predict_margin_fused(
+                st, gr, Xd, cuts, base, 4, 1, tree_chunk=chunk))
+
+
+# ---------------------------------------------------------- fused serving
+def test_engine_fused_parity_and_zero_compiles(recompile_guard):
+    """The fused AOT bucket executables serve bit-identically to
+    Learner.predict and keep the zero-steady-state-compile invariant."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu.serving import PredictEngine
+    bst, X, _ = _train(rounds=5)
+    eng = PredictEngine(bst, min_bucket=8, max_bucket=64, warmup=True)
+    assert eng.describe()["fused"] is True
+    sizes = (3, 8, 17, 64, 100)                  # incl. chunk-through-top
+    # learner references OUTSIDE the guard (Learner.predict compiles its
+    # own per-N programs; the invariant under test is the ENGINE's)
+    refs = {n: bst.predict(xgb.DMatrix(X[:n])) for n in sizes}
+    with recompile_guard.expect(0):
+        for n in sizes:
+            assert np.array_equal(eng.predict(X[:n]), refs[n]), n
+
+
+def test_engine_two_step_env_fallback(monkeypatch):
+    """XGBTPU_SERVE_FUSED=0 restores the host-quantize baseline with
+    identical outputs."""
+    from xgboost_tpu.serving import PredictEngine
+    bst, X, _ = _train(rounds=4)
+    fused = PredictEngine(bst, min_bucket=8, max_bucket=32)
+    monkeypatch.setenv("XGBTPU_SERVE_FUSED", "0")
+    two = PredictEngine(bst, min_bucket=8, max_bucket=32)
+    assert two.describe()["fused"] is False
+    for n in (5, 20):
+        assert np.array_equal(fused.predict(X[:n]), two.predict(X[:n]))
+
+
+def test_engine_counts_transfer_bytes():
+    from xgboost_tpu.obs.metrics import predict_metrics
+    from xgboost_tpu.serving import PredictEngine
+    bst, X, _ = _train(rounds=3, f=6)
+    eng = PredictEngine(bst, min_bucket=8, max_bucket=32, warmup=True)
+    pm = predict_metrics()
+    b0 = pm.transfer_bytes.value
+    eng.predict(X[:5])
+    # 5 rows pad to the 8-bucket: the uploaded f32 buffer is 8 x F
+    assert pm.transfer_bytes.value - b0 == 8 * 6 * 4
+
+
+# ------------------------------------------------------------ featurestore
+def test_featurestore_put_gather_parity_and_lru():
+    from xgboost_tpu.serving.featurestore import FeatureStore
+    F = 4
+    # budget for exactly 3 rows
+    store = FeatureStore(F, budget_mb=3 * F * 4 / (1 << 20))
+    assert store.capacity == 3
+    rng = np.random.RandomState(0)
+    X = rng.rand(5, F).astype(np.float32)
+    store.put(["a", "b", "c"], X[:3])
+    got, missing = store.gather(["b", "a"])
+    assert missing == []
+    assert np.array_equal(np.asarray(got), X[[1, 0]])
+    # gather refreshed b,a — "c" is now LRU and evicts first
+    store.put(["d"], X[3:4])
+    _, missing = store.gather(["c"])
+    assert missing == ["c"]
+    got, missing = store.gather(["a", "b", "d"])
+    assert missing == []
+    assert np.array_equal(np.asarray(got), X[[0, 1, 3]])
+    # updating a resident id keeps its slot and rewrites the row
+    store.put(["a"], X[4:5])
+    got, _ = store.gather(["a"])
+    assert np.array_equal(np.asarray(got), X[4:5])
+    assert store.describe()["resident_bytes"] == 3 * F * 4
+
+
+def test_featurestore_gather_pads_with_nan_rows():
+    from xgboost_tpu.serving.featurestore import FeatureStore
+    store = FeatureStore(3, budget_mb=1.0)
+    store.put(["x"], np.ones((1, 3), np.float32))
+    got, missing = store.gather(["x"], pad_to=4)
+    assert missing == []
+    g = np.asarray(got)
+    assert g.shape == (4, 3)
+    assert np.array_equal(g[0], np.ones(3, np.float32))
+    assert np.isnan(g[1:]).all()                 # pad rows -> bin 0
+
+
+def test_predict_by_id_zero_upload_parity():
+    """predict_by_id == engine.predict on the same rows, with ZERO
+    host→device feature bytes at steady state (the acceptance
+    criterion, asserted via the transfer counters)."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu.obs.metrics import predict_metrics
+    from xgboost_tpu.serving import (FeatureStore, FeatureStoreMiss,
+                                     PredictEngine, predict_by_id)
+    bst, X, _ = _train(rounds=5, f=6)
+    eng = PredictEngine(bst, min_bucket=8, max_bucket=32, warmup=True)
+    store = FeatureStore(eng.num_feature, budget_mb=1.0)
+    ids = [f"u{i}" for i in range(40)]           # > top bucket: chunks
+    store.put(ids, X[:40])
+    pm = predict_metrics()
+    b0 = pm.transfer_bytes.value
+    out = predict_by_id(eng, store, ids)
+    assert pm.transfer_bytes.value == b0         # zero feature upload
+    assert np.array_equal(out, eng.predict(X[:40]))
+    assert np.array_equal(out, bst.predict(xgb.DMatrix(X[:40])))
+    with pytest.raises(FeatureStoreMiss) as ei:
+        predict_by_id(eng, store, ["u0", "ghost"])
+    assert ei.value.missing == ["ghost"]
+    # misses spread across CHUNKS are all reported at once (one
+    # put-and-retry round trip, not one 404 per chunk) — and the miss
+    # path feeds the hit/miss counters (it IS the dominant one)
+    from xgboost_tpu.obs.metrics import featurestore_metrics
+    fm = featurestore_metrics()
+    h0, m0 = fm.hits.value, fm.misses.value
+    with pytest.raises(FeatureStoreMiss) as ei:
+        predict_by_id(eng, store, ["g1"] + ids + ["g2"])
+    assert ei.value.missing == ["g1", "g2"]
+    assert fm.misses.value - m0 == 2
+    assert fm.hits.value - h0 == len(ids)
+
+
+def test_predict_by_id_two_step_engine():
+    """A two-step (non-fused) engine still serves resident entities
+    with zero feature upload: quantize happens on device, eagerly."""
+    from xgboost_tpu.obs.metrics import predict_metrics
+    from xgboost_tpu.serving import (FeatureStore, PredictEngine,
+                                     predict_by_id)
+    bst, X, _ = _train(rounds=4, f=6)
+    eng = PredictEngine(bst, min_bucket=8, max_bucket=32, warmup=True,
+                        fused=False)
+    store = FeatureStore(eng.num_feature, budget_mb=1.0)
+    store.put(["a", "b"], X[:2])
+    pm = predict_metrics()
+    b0 = pm.transfer_bytes.value
+    out = predict_by_id(eng, store, ["a", "b"])
+    assert pm.transfer_bytes.value == b0
+    assert np.array_equal(out, eng.predict(X[:2]))
+
+
+# ------------------------------------------------------------- HTTP layer
+def _post(base, path, obj):
+    req = urllib.request.Request(base + path,
+                                 data=json.dumps(obj).encode(),
+                                 method="POST")
+    try:
+        r = urllib.request.urlopen(req)
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_predict_by_id_and_reload_rebinning(tmp_path):
+    """The serving routes end to end: put → predict_by_id (parity,
+    zero upload) → invalidate → 404; then a registry hot-reload with a
+    DIFFERENT quantization (max_bin) rebins the SAME resident raw rows
+    against the new model's cuts — predictions match the new booster
+    bit for bit with still zero feature upload."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu.obs.metrics import predict_metrics
+    from xgboost_tpu.serving import run_server
+
+    bst, X, _ = _train(rounds=5, f=6)
+    path = str(tmp_path / "m.bin")
+    bst.save_model(path)
+    srv = run_server(path, port=0, min_bucket=4, max_bucket=64,
+                     poll_sec=0, warmup=True, featurestore_mb=1.0,
+                     quiet=True, block=False)
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        ids = [f"u{i}" for i in range(10)]
+        st, res = _post(base, "/featurestore/put",
+                        {"ids": ids, "rows": X[:10].tolist()})
+        assert st == 200 and res["stored"] == 10
+        pm = predict_metrics()
+        b0 = pm.transfer_bytes.value
+        st, res = _post(base, "/predict_by_id", {"ids": ids})
+        assert st == 200
+        assert pm.transfer_bytes.value == b0     # zero-upload steady state
+        assert np.allclose(res["predictions"], bst.predict(X[:10]),
+                           rtol=0, atol=0)
+        assert res["model_version"] == 1
+        # body output_margin follows the query-string truthiness
+        # contract: "0"/"false" disable, true/"1" enable
+        st, rm = _post(base, "/predict_by_id",
+                       {"ids": ids, "output_margin": "0"})
+        assert rm["predictions"] == res["predictions"]
+        st, rm = _post(base, "/predict_by_id",
+                       {"ids": ids, "output_margin": True})
+        assert np.allclose(rm["predictions"],
+                           bst.predict(X[:10], output_margin=True),
+                           rtol=0, atol=0)
+        # misses name the absent ids
+        st, res = _post(base, "/predict_by_id", {"ids": ["u0", "ghost"]})
+        assert st == 404 and res["missing"] == ["ghost"]
+        # hot-reload with different cuts: same resident rows, new bins
+        bst2, _, _ = _train({"max_bin": 16}, rounds=6, f=6, seed=3)
+        bst2.save_model(path)
+        st, res = _post(base, "/-/reload", {})
+        assert st == 200 and res["reloaded"]
+        exp2 = bst2.predict(X[:10])              # its upload: pre-count
+        b1 = pm.transfer_bytes.value
+        st, res = _post(base, "/predict_by_id", {"ids": ids})
+        assert st == 200 and res["model_version"] == 2
+        assert np.allclose(res["predictions"], exp2, rtol=0, atol=0)
+        assert pm.transfer_bytes.value == b1     # rebinning uploaded 0
+        # invalidate drops residency
+        st, res = _post(base, "/featurestore/invalidate", {"ids": ["u0"]})
+        assert st == 200 and res["invalidated"] == 1
+        st, res = _post(base, "/predict_by_id", {"ids": ["u0"]})
+        assert st == 404
+        # metrics render the new families
+        m = urllib.request.urlopen(base + "/metrics").read().decode()
+        for fam in ("xgbtpu_featurestore_hits_total",
+                    "xgbtpu_featurestore_misses_total",
+                    "xgbtpu_featurestore_evictions_total",
+                    "xgbtpu_featurestore_resident_bytes",
+                    "xgbtpu_predict_transfer_seconds",
+                    "xgbtpu_predict_transfer_bytes_total"):
+            assert fam in m, fam
+        health = json.loads(
+            urllib.request.urlopen(base + "/healthz").read())
+        assert health["featurestore_rows"] == 9
+    finally:
+        srv.shutdown()
+
+
+def test_fused_empty_input_matches_two_step(monkeypatch):
+    """N=0 routes off the fused block pipeline (nothing to
+    concatenate) and returns the same empty result as the baseline."""
+    import xgboost_tpu as xgb
+    bst, X, _ = _train(rounds=3, f=6)
+    empty = np.zeros((0, 6), np.float32)
+    out = bst.predict(empty)
+    monkeypatch.setenv("XGBTPU_PREDICT_FUSED", "0")
+    ref = bst.predict(xgb.DMatrix(empty))
+    assert out.shape == ref.shape == (0,)
+
+
+def test_featurestore_duplicate_ids_last_wins():
+    """A repeated id in one put batch keeps its LAST row — the
+    semantics of sequential puts — instead of feeding a repeated-index
+    scatter (whose winner JAX leaves undefined)."""
+    from xgboost_tpu.serving.featurestore import FeatureStore
+    store = FeatureStore(3, budget_mb=1.0)
+    X = np.arange(12, dtype=np.float32).reshape(4, 3)
+    res = store.put(["a", "b", "a", "c"], X)
+    assert res["stored"] == 3 and len(store) == 3
+    got, missing = store.gather(["a", "b", "c"])
+    assert missing == []
+    assert np.array_equal(np.asarray(got), X[[2, 1, 3]])
+
+
+def test_featurestore_failed_put_commits_nothing():
+    """A device failure mid-put (upload/scatter) leaves membership and
+    the slab untouched: no id may ever map to a row that was not
+    written for it."""
+    from xgboost_tpu.serving.featurestore import FeatureStore
+    store = FeatureStore(2, budget_mb=2 * 2 * 4 / (1 << 20))
+    assert store.capacity == 2
+    X = np.arange(8, dtype=np.float32).reshape(4, 2)
+    store.put(["a", "b"], X[:2])
+
+    class _Boom:
+        def asarray(self, *_a, **_k):
+            raise RuntimeError("RESOURCE_EXHAUSTED (synthetic)")
+
+    real = store._jnp
+    store._jnp = _Boom()
+    try:
+        with pytest.raises(RuntimeError):
+            store.put(["c"], X[2:3])             # would evict LRU "a"
+    finally:
+        store._jnp = real
+    # the failed put evicted nothing and mapped nothing
+    got, missing = store.gather(["a", "b"])
+    assert missing == []
+    assert np.array_equal(np.asarray(got), X[:2])
+    _, missing = store.gather(["c"])
+    assert missing == ["c"]
+
+
+def test_http_reload_width_change_swaps_store(tmp_path):
+    """A hot-reload to a model with a DIFFERENT feature count drops the
+    store (resident rows are meaningless at the new width): by-id
+    requests 404 as misses — never a shape-mismatched executable — and
+    new-width puts are accepted."""
+    import xgboost_tpu as xgb  # noqa: F401
+    from xgboost_tpu.serving import run_server
+
+    bst, X, _ = _train(rounds=3, f=6)
+    path = str(tmp_path / "m.bin")
+    bst.save_model(path)
+    srv = run_server(path, port=0, min_bucket=4, max_bucket=16,
+                     poll_sec=0, warmup=False, featurestore_mb=1.0,
+                     quiet=True, block=False)
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        st, _res = _post(base, "/featurestore/put",
+                         {"ids": ["a"], "rows": X[:1].tolist()})
+        assert st == 200
+        bst8, X8, _ = _train(rounds=3, f=8, seed=2)
+        bst8.save_model(path)
+        st, res = _post(base, "/-/reload", {})
+        assert st == 200 and res["reloaded"]
+        st, res = _post(base, "/predict_by_id", {"ids": ["a"]})
+        assert st == 404 and res["missing"] == ["a"]
+        st, res = _post(base, "/featurestore/put",
+                        {"ids": ["a"], "rows": X8[:1].tolist()})
+        assert st == 200 and res["num_feature"] == 8
+        st, res = _post(base, "/predict_by_id", {"ids": ["a"]})
+        assert st == 200
+        assert np.allclose(res["predictions"], bst8.predict(X8[:1]),
+                           rtol=0, atol=0)
+    finally:
+        srv.shutdown()
+
+
+def test_sparse_ndarray_keeps_host_binned_path():
+    """The direct-buffer shortcut is an upload optimization, not a
+    routing override: a mostly-NaN ndarray stays on the O(nnz)
+    host-binning path (small-int bin upload), never ships the full f32
+    matrix."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu.binning import bin_matrix
+    from xgboost_tpu.obs.metrics import predict_metrics
+    bst, X, _ = _train(rounds=3, f=6)
+    Xs = np.full((300, 6), np.nan, np.float32)
+    Xs[:, 0] = X[:300, 0]                        # ~17%: below the gate
+    ref = bst.predict(xgb.DMatrix(Xs))
+    pm = predict_metrics()
+    b0 = pm.transfer_bytes.value
+    out = bst.predict(np.ascontiguousarray(Xs))
+    delta = pm.transfer_bytes.value - b0
+    binned = bin_matrix(xgb.DMatrix(Xs), bst.gbtree.cuts)
+    assert delta == binned.nbytes                # bins, not f32 rows
+    assert np.array_equal(ref, out)
+
+
+def test_http_featurestore_put_device_failure_500(tmp_path):
+    """A device failure inside put surfaces as a 500 JSON error (and
+    commits nothing) instead of a dropped connection."""
+    import xgboost_tpu as xgb  # noqa: F401
+    from xgboost_tpu.serving import run_server
+    bst, X, _ = _train(rounds=2, f=6)
+    path = str(tmp_path / "m.bin")
+    bst.save_model(path)
+    srv = run_server(path, port=0, min_bucket=4, max_bucket=16,
+                     poll_sec=0, warmup=False, featurestore_mb=1.0,
+                     quiet=True, block=False)
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+
+        class _Boom:
+            def asarray(self, *_a, **_k):
+                raise RuntimeError("RESOURCE_EXHAUSTED (synthetic)")
+
+        real = srv.featurestore._jnp
+        srv.featurestore._jnp = _Boom()
+        try:
+            st, res = _post(base, "/featurestore/put",
+                            {"ids": ["a"], "rows": X[:1].tolist()})
+        finally:
+            srv.featurestore._jnp = real
+        assert st == 500 and "RESOURCE_EXHAUSTED" in res["error"]
+        assert len(srv.featurestore) == 0        # committed nothing
+        # the mutating store routes pass the drain admission gate:
+        # a draining server must not accept new device uploads
+        srv.state = "draining"
+        try:
+            st, res = _post(base, "/featurestore/put",
+                            {"ids": ["a"], "rows": X[:1].tolist()})
+            assert st == 503 and "draining" in res["error"]
+            st, res = _post(base, "/featurestore/invalidate",
+                            {"all": True})
+            assert st == 503
+        finally:
+            srv.state = "serving"
+    finally:
+        srv.shutdown()
+
+
+def test_http_featurestore_disabled_404(tmp_path):
+    import xgboost_tpu as xgb  # noqa: F401
+    from xgboost_tpu.serving import run_server
+    bst, X, _ = _train(rounds=2, f=6)
+    path = str(tmp_path / "m.bin")
+    bst.save_model(path)
+    srv = run_server(path, port=0, min_bucket=4, max_bucket=16,
+                     poll_sec=0, warmup=False, quiet=True, block=False)
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        st, res = _post(base, "/predict_by_id", {"ids": ["a"]})
+        assert st == 404 and "disabled" in res["error"]
+        st, res = _post(base, "/featurestore/put",
+                        {"ids": ["a"], "rows": [[0.0] * 6]})
+        assert st == 404
+    finally:
+        srv.shutdown()

@@ -10,8 +10,17 @@ speedup.  JSON output like ``tools/bench_serving.py``::
 
     python tools/predict_microbench.py [PREDICT_MICROBENCH.json]
 
+Round 7 adds END-TO-END cells (``e2e_cells``): raw f32 row blocks
+upload through ``external._prefetch_to_device`` and predict, A/B-ing
+upload depth (0 = synchronous, 1, 2 = double-buffered) × fused
+quantize+traverse vs the two-step quantize-then-traverse — the
+transfer-wall knobs of PROFILE.md round 7, with a per-cell bitwise
+assert that fused margins equal two-step margins.
+
 Env knobs: ``PRED_MB_SHAPES`` ("T,N,depth;..." cells),
-``PRED_MB_CHUNKS`` (comma list), ``PRED_MB_REPS`` (default 5).
+``PRED_MB_CHUNKS`` (comma list), ``PRED_MB_REPS`` (default 5),
+``PRED_MB_E2E_SHAPES`` (e2e "T,N,depth;..." cells),
+``PRED_MB_E2E_DEPTHS`` (upload depths, default "1,2").
 """
 
 import json
@@ -27,12 +36,16 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 from xgboost_tpu.models.tree import (  # noqa: E402
-    TreeArrays, predict_margin_binned, tree_capacity)
+    TreeArrays, predict_margin_binned, predict_margin_fused,
+    tree_capacity)
 
 N_FEAT = 28
 N_BIN = 64
 DEFAULT_SHAPES = "100,1000000,6;100,100000,6;20,100000,6;100,100000,10"
 DEFAULT_CHUNKS = "8,32"
+DEFAULT_E2E_SHAPES = "100,200000,6;100,1000000,6"
+DEFAULT_E2E_DEPTHS = "1,2"
+E2E_BLOCKS = 4  # raw f32 row blocks per end-to-end prediction
 
 
 def synth_ensemble(T, depth, n_feat, n_bin, seed=0):
@@ -78,6 +91,83 @@ def timeit(fn, reps):
     return best * 1e3, out
 
 
+def synth_raw(N, n_feat, n_bin, seed=3):
+    """Raw f32 rows (with some NaN missing) + a sorted finite cut
+    matrix: the end-to-end cells quantize these on device, so the
+    two-step and fused paths start from identical host bytes."""
+    rng = np.random.RandomState(seed)
+    X = rng.rand(N, n_feat).astype(np.float32)
+    X[:: 13, 0] = np.nan
+    cuts = np.sort(rng.rand(n_feat, n_bin - 2).astype(np.float32),
+                   axis=1)
+    return X, cuts
+
+
+def run_e2e(X, cuts, stack, group, depth, tree_chunk, upload_depth,
+            fused):
+    """One end-to-end prediction: raw f32 blocks → prefetch upload →
+    (quantize →) traverse → concatenated margins.  This is the
+    learner's one-off pipeline with the learner stripped away."""
+    from xgboost_tpu.binning import bin_dense_device
+    from xgboost_tpu.external import _prefetch_to_device
+    N = X.shape[0]
+    block = -(-N // E2E_BLOCKS)
+    base = jnp.zeros((), jnp.float32)
+    cuts_dev = jnp.asarray(cuts)
+
+    def blocks():
+        for s in range(0, N, block):
+            yield s, X[s:s + block]
+
+    parts = []
+    for _, xd in _prefetch_to_device(blocks(), depth=upload_depth):
+        if fused:
+            parts.append(predict_margin_fused(
+                stack, group, xd, cuts_dev, base, depth, 1,
+                tree_chunk=tree_chunk))
+        else:
+            parts.append(predict_margin_binned(
+                stack, group, bin_dense_device(xd, cuts_dev), base,
+                depth, 1, tree_chunk=tree_chunk))
+    return jnp.concatenate(parts, axis=0)
+
+
+def e2e_main(reps, chunk):
+    """End-to-end (upload+quantize+traverse) A/B grid: upload depth ×
+    fused-vs-two-step, per shape.  Margins are bit-asserted equal
+    across every variant of a cell."""
+    shapes = [tuple(int(v) for v in cell.split(","))
+              for cell in os.environ.get(
+                  "PRED_MB_E2E_SHAPES", DEFAULT_E2E_SHAPES).split(";")
+              if cell]
+    depths = [int(d) for d in os.environ.get(
+        "PRED_MB_E2E_DEPTHS", DEFAULT_E2E_DEPTHS).split(",")]
+    cells = []
+    for T, N, depth in shapes:
+        X, cuts = synth_raw(N, N_FEAT, N_BIN)
+        stack, group = synth_ensemble(T, depth, N_FEAT, N_BIN)
+        cell = {"T": T, "N": N, "depth": depth, "blocks": E2E_BLOCKS,
+                "tree_chunk": chunk}
+        ref = None
+        for fused in (False, True):
+            for d in depths:
+                ms, m = timeit(lambda: run_e2e(
+                    X, cuts, stack, group, depth, chunk, d, fused),
+                    reps)
+                key = f"{'fused' if fused else 'twostep'}_depth{d}"
+                cell[f"{key}_ms"] = round(ms, 2)
+                cell[f"{key}_rows_per_sec"] = round(N / (ms / 1e3), 1)
+                if ref is None:
+                    ref = np.asarray(m)
+                else:
+                    bit = bool(np.array_equal(ref, np.asarray(m)))
+                    cell[f"{key}_bit_identical"] = bit
+                    assert bit, f"e2e margins diverged at {key} T={T}"
+        cells.append(cell)
+        print(json.dumps(cell))
+    return cells
+
+
 def main():
     shapes = [tuple(int(v) for v in cell.split(","))
               for cell in os.environ.get(
@@ -110,10 +200,14 @@ def main():
             assert bit, f"chunked margins diverged at T={T} chunk={c}"
         cells.append(cell)
         print(json.dumps(cell))
+    # e2e cells traverse at the auto-gate chunk (32 on TPU, scan on
+    # CPU — gbtree.pred_chunk's own resolution), so the committed
+    # numbers reflect what Learner.predict actually runs per backend
+    e2e = e2e_main(reps, 32 if jax.default_backend() == "tpu" else 0)
     out = {"metric": "predict_traversal_scan_vs_chunked_ms",
            "backend": jax.default_backend(),
            "reps_best_of": reps, "n_feat": N_FEAT, "n_bin": N_BIN,
-           "cells": cells}
+           "cells": cells, "e2e_cells": e2e}
     if len(sys.argv) > 1:
         with open(sys.argv[1], "w") as f:
             json.dump(out, f, indent=2)

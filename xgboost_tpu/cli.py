@@ -517,6 +517,7 @@ class BoostLearnTask:
             warmup=bool(sp["serve_warmup"]),
             drain_sec=sp["serve_drain_sec"],
             max_body_mb=sp["serve_max_body_mb"],
+            featurestore_mb=sp["serve_featurestore_mb"],
             quiet=self.silent != 0, block=True)
         return 0
 

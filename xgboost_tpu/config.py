@@ -271,6 +271,10 @@ SERVE_PARAMS: Dict[str, Tuple[Any, str]] = {
     "serve_max_body_mb": (64.0, "largest accepted request body; bigger "
                                 "Content-Length is rejected with 413 "
                                 "before buffering"),
+    "serve_featurestore_mb": (0.0, "device byte budget for the "
+                                   "hot-entity feature store backing "
+                                   "POST /predict_by_id (0 disables; "
+                                   "LRU-evicts past the budget)"),
 }
 
 

@@ -383,21 +383,40 @@ class ExtMemDMatrix:
         yield from _prefetch_to_device(self.binned_batches())
 
 
-def _prefetch_to_device(batches, depth: int = 2):
+def _prefetch_to_device(batches, depth: int = 2, observe=None):
     """Stage (start, np_batch) pairs to the device from a worker thread,
-    ``depth`` batches ahead.  jax.device_put is thread-safe; the
+    ``depth`` batches ahead (``depth=0`` degrades to synchronous inline
+    staging — the A/B baseline).  jax.device_put is thread-safe; the
     consumer's compute dispatches interleave with the worker's uploads
     on the host side, and the device runtime orders them on its stream.
     Exceptions propagate to the consumer.
 
     Shared upload/compute-overlap seam: paged training and prediction
-    consume it through :meth:`ExtMemDMatrix.device_batches`, and
-    ``Learner._bin_dense_blocked`` reuses it so row-block f32 uploads
-    of over-guard one-off predictions overlap the device quantize
-    (and the traversal that follows) instead of serializing through
-    the tunnel."""
+    consume it through :meth:`ExtMemDMatrix.device_batches`, and the
+    learner's blocked one-off prediction (``Learner._predict_fused_
+    blocked`` / ``_bin_dense_blocked``) reuses it so row-block f32
+    uploads overlap the device quantize+traverse of the previous block
+    instead of serializing through the tunnel
+    (``XGBTPU_PREDICT_UPLOAD_DEPTH`` picks the prediction-path depth).
+
+    ``observe``, when given, is called with ``(nbytes, seconds)`` per
+    upload (the prediction transfer counters); timing then blocks the
+    WORKER on upload completion — the consumer still overlaps, and the
+    number measures transfer, not dispatch."""
     import queue
     import threading
+
+    def _put(b):
+        if observe is None:
+            return jax.device_put(b)
+        from xgboost_tpu.obs.metrics import timed_device_put
+        return timed_device_put(b, observe)
+
+    if depth <= 0:
+        def _sync():
+            for start, b in batches:
+                yield start, _put(b)
+        return _sync()
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _END = object()
@@ -408,31 +427,34 @@ def _prefetch_to_device(batches, depth: int = 2):
             for start, b in batches:
                 if stop.is_set():
                     return
-                q.put((start, jax.device_put(b)))
+                q.put((start, _put(b)))
             q.put(_END)
         except BaseException as e:  # noqa: BLE001 - relayed to consumer
             q.put(e)
 
-    t = threading.Thread(target=worker, daemon=True)
-    t.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        # early-closed generator: unblock + retire the worker so its
-        # memmap reads don't outlive the matrix
-        stop.set()
+    def _piped():
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
         try:
             while True:
-                q.get_nowait()
-        except queue.Empty:
-            pass
-        t.join(timeout=5.0)
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # early-closed generator: unblock + retire the worker so its
+            # memmap reads don't outlive the matrix
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)
+
+    return _piped()
 
 
 _budget_cache: Optional[int] = None

@@ -33,6 +33,20 @@ from xgboost_tpu.objectives import create_objective
 _MAGIC = "xgbtpu001"
 
 
+def _predict_upload_depth() -> int:
+    """Prefetch depth of the one-off prediction upload pipeline: how
+    many f32 row blocks stage ahead of the quantize+traverse consuming
+    them (external._prefetch_to_device).  2 = double-buffered (block
+    k+1 uploads while block k computes); 1 = single lookahead; 0 =
+    synchronous.  ``XGBTPU_PREDICT_UPLOAD_DEPTH`` is the A/B seam
+    (tools/predict_microbench.py e2e cells)."""
+    try:
+        return max(0, int(os.environ.get("XGBTPU_PREDICT_UPLOAD_DEPTH",
+                                         "2")))
+    except ValueError:
+        return 2
+
+
 class _CacheEntry:
     """Per-DMatrix device state (the reference's CacheEntry,
     learner-inl.hpp:495-512)."""
@@ -979,25 +993,35 @@ class Booster:
                 e.applied = 0
             self._sync_margin(entry)
 
-    def _bin_dense_blocked(self, data: DMatrix):
-        """Device-side quantization of a dense-enough matrix, chunked
-        over row blocks past the ``2^31``-byte single-buffer guard (a
-        20M x 28 one-off prediction used to silently fall back to the
-        seconds-long host ``searchsorted`` loop).
-
-        Row blocks densify straight from the CSR arrays — the host
-        working set is ONE f32 block, never a full N x F densify — and
-        are staged by :func:`external._prefetch_to_device`, so the f32
-        upload of block i+1 overlaps the quantize of block i instead of
-        serializing through the tunnel.  The block budget is 256 MB
-        (small against the guard, but thousands of rows even at wide F;
-        with the depth-2 prefetch queue at most ~4 blocks are in flight
+    def _predict_block_rows(self, data) -> int:
+        """Row-block size for one-off dense prediction uploads: whole
+        matrix while under the ``2^31``-byte single-buffer guard, else
+        256 MB f32 blocks (thousands of rows even at wide F; with the
+        depth-2 prefetch queue at most ~4 blocks are in flight
         device-side).  ``XGBTPU_BIN_BLOCK_BYTES`` overrides (test
         seam)."""
-        from xgboost_tpu.binning import bin_dense_device
-        cv = self.gbtree.cuts.cut_values
         Fm = self.gbtree.cuts.num_feature
         N = data.num_row
+        budget = int(os.environ.get("XGBTPU_BIN_BLOCK_BYTES", 0))
+        if not budget and N * Fm * 4 <= (1 << 31):
+            return max(N, 1)
+        return max(1, (budget or (1 << 28)) // (4 * max(Fm, 1)))
+
+    def _dense_block_fn(self, data):
+        """``(s, e) -> (e-s, Fm) f32`` dense row blocks (NaN = missing).
+
+        When ``Booster.predict`` wrapped a plain C-contiguous f32
+        ndarray of model width, blocks are zero-copy VIEWS of the
+        caller's own buffer — the CSR round-trip and the per-block
+        densify copy are skipped entirely and the caller's memory
+        uploads directly (round-7 satellite; NaN is the missing marker
+        on both paths, so blocks are value-identical).  Otherwise
+        blocks densify straight from the CSR arrays: the host working
+        set is ONE f32 block, never a full N x F densify."""
+        Fm = self.gbtree.cuts.num_feature
+        src = getattr(data, "_predict_dense_src", None)
+        if src is not None and src.shape[1] == Fm:
+            return lambda s, e: src[s:e]
 
         def dense_block(s, e):
             Xb = np.full((e - s, Fm), np.nan, np.float32)
@@ -1009,21 +1033,112 @@ class Booster:
             Xb[rows[keep], cols[keep]] = data.values[lo:hi][keep]
             return Xb
 
-        budget = int(os.environ.get("XGBTPU_BIN_BLOCK_BYTES", 0))
-        if not budget and N * Fm * 4 <= (1 << 31):
-            return bin_dense_device(dense_block(0, N), cv)
-        block = max(1, (budget or (1 << 28)) // (4 * max(Fm, 1)))
+        return dense_block
+
+    def _bin_dense_blocked(self, data: DMatrix):
+        """Device-side quantization of a dense-enough matrix, chunked
+        over row blocks past the ``2^31``-byte single-buffer guard (a
+        20M x 28 one-off prediction used to silently fall back to the
+        seconds-long host ``searchsorted`` loop).
+
+        This is the TWO-STEP path (binned matrix materialized in HBM):
+        ``pred_leaf`` and the ``XGBTPU_PREDICT_FUSED=0`` baseline use
+        it; the margin fast path fuses quantize into the traversal
+        program instead (:meth:`_predict_fused_blocked`).  Blocks stage
+        through :func:`external._prefetch_to_device` at the
+        ``XGBTPU_PREDICT_UPLOAD_DEPTH`` lookahead, and every upload
+        feeds the ``xgbtpu_predict_transfer_*`` counters."""
+        from xgboost_tpu.binning import bin_dense_device
+        from xgboost_tpu.obs.metrics import predict_metrics
+        cv = self.gbtree.cuts.cut_values
+        N = data.num_row
+        block = self._predict_block_rows(data)
+        blk = self._dense_block_fn(data)
+        pm = predict_metrics()
         if N <= block:
-            return bin_dense_device(dense_block(0, N), cv)
+            from xgboost_tpu.obs.metrics import timed_device_put
+            return bin_dense_device(
+                timed_device_put(blk(0, N), pm.observe_transfer), cv)
         from xgboost_tpu.external import _prefetch_to_device
 
         def host_blocks():
             for s in range(0, N, block):
-                yield s, dense_block(s, min(s + block, N))
+                yield s, blk(s, min(s + block, N))
 
         parts = [bin_dense_device(xb, cv)
-                 for _, xb in _prefetch_to_device(host_blocks())]
+                 for _, xb in _prefetch_to_device(
+                     host_blocks(), depth=_predict_upload_depth(),
+                     observe=pm.observe_transfer)]
         return jnp.concatenate(parts, axis=0)
+
+    def _fused_predict_ok(self, data, pred_leaf: bool) -> bool:
+        """Gate for the fused one-off margin path: margins only
+        (pred_leaf needs the leaf matrix), non-empty input (the block
+        pipeline has nothing to concatenate at N=0; the two-step path
+        already returns the (0,) result), single-device placement (the
+        mesh path keeps the two-step upload), no multi-root routing
+        (root vectors would need per-block slicing), and the
+        ``XGBTPU_PREDICT_FUSED`` A/B seam (0 = two-step baseline)."""
+        return (not pred_leaf
+                and data.num_row > 0
+                and os.environ.get("XGBTPU_PREDICT_FUSED", "1") != "0"
+                and self._mesh is None and self._col_mesh is None
+                and not (getattr(data.info, "root_index", None) is not None
+                         and max(1, self.param.num_roots) > 1))
+
+    def _predict_fused_blocked(self, data, ntree_limit: int = 0):
+        """One-off dense prediction margins through the FUSED
+        quantize+traverse program (round 7 — the transfer wall): raw
+        f32 row blocks upload through the
+        ``XGBTPU_PREDICT_UPLOAD_DEPTH``-deep prefetch pipeline (block
+        k+1's upload overlaps block k's quantize+traverse), margins
+        come out of ONE compiled program per block, and the binned
+        matrix never exists outside it — no second HBM buffer, no extra
+        launch boundary.  Every upload feeds the
+        ``xgbtpu_predict_transfer_*`` counters.  Bit-identical to the
+        two-step path: the quantize sub-graph is
+        ``binning.bin_dense_device`` itself and traversal is
+        row-independent, so per-block margins concatenate to exactly
+        the whole-matrix result (tests/test_predict_fused.py)."""
+        from xgboost_tpu.external import _prefetch_to_device
+        from xgboost_tpu.obs.metrics import predict_metrics
+        N = data.num_row
+        K = self._K
+        block = self._predict_block_rows(data)
+        blk = self._dense_block_fn(data)
+        bm = data.info.base_margin
+        if bm is None:
+            base_all = None
+            base0 = jnp.full((), self.obj.prob_to_margin(
+                self.param.base_score), jnp.float32)
+        else:
+            base_all = np.asarray(bm, np.float32).reshape(N, K)
+            base0 = None
+        pm = predict_metrics()
+        if N <= block:
+            # single block (virtually all under-guard predicts): skip
+            # the prefetch worker thread/queue — inline timed upload,
+            # one fused program call (mirrors _bin_dense_blocked)
+            from xgboost_tpu.obs.metrics import timed_device_put
+            xd = timed_device_put(blk(0, N), pm.observe_transfer)
+            base = (base0 if base_all is None
+                    else jnp.asarray(base_all))
+            return self.gbtree.predict_margin_fused(xd, base, ntree_limit)
+
+        def host_blocks():
+            for s in range(0, N, block):
+                yield s, blk(s, min(s + block, N))
+
+        parts = []
+        for s, xd in _prefetch_to_device(host_blocks(),
+                                         depth=_predict_upload_depth(),
+                                         observe=pm.observe_transfer):
+            base = (base0 if base_all is None
+                    else jnp.asarray(base_all[s:s + xd.shape[0]]))
+            parts.append(self.gbtree.predict_margin_fused(
+                xd, base, ntree_limit))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts,
+                                                                axis=0)
 
     # ------------------------------------------------------------ inference
     def predict(self, data: DMatrix, output_margin: bool = False,
@@ -1037,7 +1152,17 @@ class Booster:
         re-implement the wrapping."""
         assert self.gbtree is not None, "model not trained/loaded"
         if not hasattr(data, "num_row"):  # any DMatrix flavor has it
-            data = DMatrix(np.asarray(data, dtype=np.float32))
+            arr = np.asarray(data, dtype=np.float32)
+            data = DMatrix(arr)
+            if arr.ndim == 2 and arr.flags.c_contiguous:
+                # upload the caller's own buffer: the UPLOAD path skips
+                # the CSR→dense densify copy per block and ships views
+                # of arr instead (NaN is the missing marker on both
+                # paths; see _dense_block_fn).  The DMatrix CSR itself
+                # is still built above — predict's cache/info plumbing
+                # and the density gate consume it; making it lazy for
+                # ndarray one-offs is a ROADMAP item
+                data._predict_dense_src = arr
 
         def _counted(out):
             """Attribute prediction traffic in /metrics by the rows
@@ -1104,6 +1229,7 @@ class Booster:
             if out.ndim == 2 and out.shape[1] == 1:
                 out = out[:, 0]
             return _counted(out)
+        fused = False
         if cached is None:
             # one-off prediction: no cache registration (the reference's
             # buffer_offset = -1 path, learner-inl.hpp:332-346)
@@ -1111,13 +1237,28 @@ class Booster:
                 raise ValueError(
                     f"data has {data.num_col} features, model was trained "
                     f"with {self.num_feature}")
+            # the density gate counts actual non-missing values, even
+            # for ndarray inputs carrying _predict_dense_src: a
+            # mostly-NaN ndarray must keep the O(nnz) host-binning
+            # path (u8 upload), not ship the full f32 matrix — the
+            # direct-buffer view is an UPLOAD optimization for inputs
+            # that are dense anyway, not a routing override
+            dense_enough = (len(data.values)
+                            >= 0.25 * data.num_row * max(data.num_col, 1))
             if self.param.booster == "gblinear":
                 binned = self.gbtree.device_matrix(data)
             elif getattr(self.gbtree, "exact_raw", False):
                 # exact mode routes on RAW values (no bins exist)
                 binned = self._raw_dense(data)[0]
-            elif (len(data.values)
-                      >= 0.25 * data.num_row * max(data.num_col, 1)):
+            elif dense_enough and self._fused_predict_ok(data, pred_leaf):
+                # FUSED quantize+traverse (round 7): raw f32 blocks
+                # upload (prefetch-overlapped) and margins come out of
+                # one compiled program per block — the binned matrix
+                # never exists outside it.  The margin branch below
+                # routes to the fused block pipeline.
+                binned = None
+                fused = True
+            elif dense_enough:
                 # quantize ON DEVICE: the host searchsorted loop costs
                 # seconds at 1M rows where the fused compare-reduce is
                 # ~2 ms (binning.bin_dense_device); the per-block f32
@@ -1132,8 +1273,13 @@ class Booster:
                 # device working set)
                 binned = self._bin_dense_blocked(data)
             else:
-                binned = jnp.asarray(bin_matrix(data, self.gbtree.cuts))
-            base = self._base_margin_of(data, data.num_row)
+                from xgboost_tpu.obs.metrics import (predict_metrics,
+                                                     timed_device_put)
+                binned = timed_device_put(
+                    bin_matrix(data, self.gbtree.cuts),
+                    predict_metrics().observe_transfer)
+            base = (None if fused
+                    else self._base_margin_of(data, data.num_row))
         else:
             binned, base = cached.binned, cached.base
         if cached is not None:
@@ -1152,6 +1298,8 @@ class Booster:
         if cached is not None and ntree_limit == 0:
             self._sync_margin(cached)
             margin = cached.margin
+        elif fused:
+            margin = self._predict_fused_blocked(data, ntree_limit)
         else:
             margin = self.gbtree.predict_margin(binned, base, ntree_limit,
                                                 root=root)

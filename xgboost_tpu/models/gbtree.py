@@ -26,7 +26,8 @@ from xgboost_tpu.binning import CutMatrix, _rank0
 from xgboost_tpu.config import TrainParam
 from xgboost_tpu.models.tree import (GrowConfig, TreeArrays, grow_tree,
                                      predict_leaf_binned,
-                                     predict_margin_binned, table_lookup,
+                                     predict_margin_binned,
+                                     predict_margin_fused, table_lookup,
                                      tree_capacity)
 from xgboost_tpu.ops.split import SplitConfig
 
@@ -696,6 +697,25 @@ class GBTree:
         return predict_margin_binned(
             stack, group, binned, base, self.cfg.max_depth, K,
             root=root, n_roots=self.cfg.n_roots,
+            tree_chunk=self.pred_chunk)
+
+    def predict_margin_fused(self, X: jax.Array, base: jax.Array,
+                             ntree_limit: int = 0,
+                             root: Optional[jax.Array] = None) -> jax.Array:
+        """Margins straight from RAW f32 feature rows (NaN = missing):
+        the fused quantize+traverse program (models/tree.py, round 7).
+        Bit-identical to ``predict_margin(bin_dense_device(X, cuts), ...)``
+        — the quantize sub-graph is the same function.  ``X`` must be
+        width-matched to the model's cut matrix (callers NaN-pad)."""
+        if self.exact_raw:
+            raise NotImplementedError(
+                "exact-mode models route on raw values already; the "
+                "fused quantize+traverse applies to binned models only")
+        stack, group = self._stack(ntree_limit)
+        K = max(1, self.param.num_output_group)
+        return predict_margin_fused(
+            stack, group, X, self.cut_values_dev, base,
+            self.cfg.max_depth, K, root=root, n_roots=self.cfg.n_roots,
             tree_chunk=self.pred_chunk)
 
     def predict_incremental(self, binned: jax.Array, margin: jax.Array,

@@ -772,6 +772,84 @@ def predict_margin_binned(stack: TreeArrays, tree_group: jax.Array,
                                    root, n_roots, C)
 
 
+# ---------------------------------------------------- fused quantize+traverse
+
+def _quantize_in_graph(X: jax.Array, cut_values: jax.Array) -> jax.Array:
+    """Device quantization as a traceable sub-graph: the EXACT expression
+    of :func:`binning.bin_dense_device` (one function, imported — not a
+    copy), so the fused program's bin ids are bit-identical to the
+    two-step path's by construction.  Raw f32 rows in (NaN = missing),
+    small-int bin ids out; the binned matrix exists only as an XLA
+    intermediate — it never materializes host-side."""
+    from xgboost_tpu.binning import bin_dense_device
+    return bin_dense_device(X, cut_values)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_group",
+                                             "n_roots"))
+def _predict_margin_fused_scan(stack: TreeArrays, tree_group: jax.Array,
+                               X: jax.Array, cut_values: jax.Array,
+                               base: jax.Array, max_depth: int,
+                               n_group: int,
+                               root: Optional[jax.Array] = None,
+                               n_roots: int = 1) -> jax.Array:
+    binned = _quantize_in_graph(X, cut_values)
+    return _predict_margin_scan.__wrapped__(stack, tree_group, binned,
+                                            base, max_depth, n_group,
+                                            root, n_roots)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_group",
+                                             "n_roots", "tree_chunk"))
+def _predict_margin_fused_chunked(stack: TreeArrays, tree_group: jax.Array,
+                                  n_valid: jax.Array, X: jax.Array,
+                                  cut_values: jax.Array, base: jax.Array,
+                                  max_depth: int, n_group: int,
+                                  root: Optional[jax.Array], n_roots: int,
+                                  tree_chunk: int) -> jax.Array:
+    binned = _quantize_in_graph(X, cut_values)
+    return _predict_margin_chunked.__wrapped__(
+        stack, tree_group, n_valid, binned, base, max_depth, n_group,
+        root, n_roots, tree_chunk)
+
+
+def predict_margin_fused(stack: TreeArrays, tree_group: jax.Array,
+                         X: jax.Array, cut_values: jax.Array,
+                         base: jax.Array, max_depth: int, n_group: int,
+                         root: Optional[jax.Array] = None,
+                         n_roots: int = 1,
+                         tree_chunk: int = 0) -> jax.Array:
+    """FUSED quantize+traverse: raw f32 feature rows (NaN = missing) go
+    cut-compare → bin ids → margins inside ONE jitted program.
+
+    The transfer-wall companion of :func:`predict_margin_binned` (round
+    7): a one-off prediction uploads raw f32 blocks and never
+    materializes the binned matrix outside the program — no second
+    device buffer, no extra launch boundary, and on hosts where the
+    upload dominates (PROFILE.md) the quantize+traverse cost hides
+    under the NEXT block's upload (learner's prefetch pipeline).
+
+    Bit-parity contract: the quantize sub-graph IS
+    ``binning.bin_dense_device`` (imported, not re-derived) and the
+    traversal cores are the two-step path's own (``__wrapped__`` of the
+    same jitted functions), so margins are bit-identical to
+    quantize-then-:func:`predict_margin_binned` on the same rows
+    (tests/test_predict_fused.py).  Same ladder/padding discipline:
+    compiled programs are keyed on the ladder rung, not the raw T."""
+    if tree_chunk <= 1:
+        return _predict_margin_fused_scan(stack, tree_group, X, cut_values,
+                                          base, max_depth, n_group, root,
+                                          n_roots)
+    _, C, _ = predict_chunk_layout(int(stack.feature.shape[0]),
+                                   tree_chunk)
+    stack, tree_group, n_valid = pad_predict_stack(stack, tree_group,
+                                                   tree_chunk)
+    return _predict_margin_fused_chunked(stack, tree_group,
+                                         jnp.int32(n_valid), X, cut_values,
+                                         base, max_depth, n_group, root,
+                                         n_roots, C)
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_roots"))
 def _predict_leaf_scan(stack: TreeArrays, binned: jax.Array,
                        max_depth: int, root: Optional[jax.Array] = None,

@@ -7,9 +7,11 @@ single device calls with bounded-queue backpressure;
 :class:`ModelRegistry` hot-reloads a watched model path atomically with
 rollback, CRC verification before build, and poisoned-fingerprint
 memory for corrupt files (RELIABILITY.md); :class:`PredictServer` is
-the stdlib HTTP front end with ``/predict``, ``/healthz`` (degraded /
-drain states) and Prometheus ``/metrics``, draining gracefully on
-SIGTERM.
+the stdlib HTTP front end with ``/predict``, ``/predict_by_id``,
+``/healthz`` (degraded / drain states) and Prometheus ``/metrics``,
+draining gracefully on SIGTERM; :class:`FeatureStore` pins hot-entity
+feature rows on device so repeat traffic predicts with zero
+host→device feature bytes (SERVING.md).
 
 Quickstart::
 
@@ -21,6 +23,9 @@ model_in=m.bin serve_port=8080``.
 
 from xgboost_tpu.serving.batcher import MicroBatcher, QueueFull
 from xgboost_tpu.serving.engine import PredictEngine, power_of_two_buckets
+from xgboost_tpu.serving.featurestore import (FeatureStore,
+                                              FeatureStoreMiss,
+                                              predict_by_id)
 from xgboost_tpu.serving.http import PredictServer, run_server
 from xgboost_tpu.serving.registry import ModelRegistry
 
@@ -32,4 +37,7 @@ __all__ = [
     "PredictServer",
     "run_server",
     "power_of_two_buckets",
+    "FeatureStore",
+    "FeatureStoreMiss",
+    "predict_by_id",
 ]

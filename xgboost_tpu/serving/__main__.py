@@ -41,6 +41,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                keep_versions=args.keep_versions,
                warmup=bool(args.warmup), drain_sec=args.drain_sec,
                max_body_mb=args.max_body_mb,
+               featurestore_mb=args.featurestore_mb,
                quiet=args.quiet, block=True)
     return 0
 

@@ -13,10 +13,18 @@ Bitwise parity: tree traversal, margin accumulation and the objective's
 pred_transform are all row-independent, so the unpadded rows of a
 padded batch are bit-identical to ``Learner.predict`` on the same rows
 (padding rows ride along on bin 0 and are sliced off host-side).
+
+Round 7 (the transfer wall): bucket executables default to the FUSED
+quantize+traverse program — raw f32 rows (plus the device-resident cut
+matrix) in, margins out, quantize in-graph — killing the per-request
+host ``bin_matrix`` pass, and ``predict_resident`` runs the same
+executables on device-resident feature-store rows with zero upload.
+``XGBTPU_SERVE_FUSED=0`` restores the host-quantize two-step baseline.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time as _time
 from bisect import bisect_left
@@ -47,6 +55,17 @@ def power_of_two_buckets(min_bucket: int = DEFAULT_MIN_BUCKET,
     return out or [max_bucket]
 
 
+def pad_to_width(X: np.ndarray, num_feature: int) -> np.ndarray:
+    """NaN-pad narrow feature rows to the model's width (NaN = missing
+    quantizes to bin 0 on every path).  The ONE definition of
+    missing-width semantics — the fused/two-step engine payloads and
+    the feature store all route through it."""
+    if X.shape[1] < num_feature:
+        X = np.pad(X, ((0, 0), (0, num_feature - X.shape[1])),
+                   constant_values=np.nan)
+    return X
+
+
 class PredictEngine:
     """Batched, recompile-free prediction over one loaded model.
 
@@ -64,7 +83,8 @@ class PredictEngine:
     def __init__(self, model, buckets: Optional[Sequence[int]] = None,
                  min_bucket: int = DEFAULT_MIN_BUCKET,
                  max_bucket: int = DEFAULT_MAX_BUCKET,
-                 warmup: bool = False, metrics=None):
+                 warmup: bool = False, metrics=None,
+                 fused: Optional[bool] = None):
         from xgboost_tpu.learner import Booster
         if isinstance(model, Booster):
             booster = model
@@ -120,6 +140,18 @@ class PredictEngine:
         self._tree_chunk = self.gbtree.pred_chunk
         _, _, self._n_chunks = predict_chunk_layout(
             int(self._stack.feature.shape[0]), max(self._tree_chunk, 1))
+        # FUSED quantize+traverse buckets (round 7): the executable
+        # takes RAW f32 rows + the device-resident cut matrix and
+        # quantizes in-graph — no host bin_matrix pass per request, and
+        # the same executables serve device-resident feature-store rows
+        # with zero upload (predict_resident).  Bit-parity with the
+        # two-step path holds because the in-graph quantize IS
+        # binning.bin_dense_device; ``XGBTPU_SERVE_FUSED=0`` (or
+        # fused=False) restores the host-quantize baseline.
+        if fused is None:
+            fused = os.environ.get("XGBTPU_SERVE_FUSED", "1") != "0"
+        self._fused = bool(fused)
+        self._cuts_dev = self.gbtree.cut_values_dev
         self._warming = False
         if warmup:
             self.warmup()
@@ -133,9 +165,18 @@ class PredictEngine:
 
     # ------------------------------------------------------------- compile
     def _margin_fn(self):
-        from xgboost_tpu.models.tree import predict_margin_binned
+        from xgboost_tpu.models.tree import (predict_margin_binned,
+                                             predict_margin_fused)
         max_depth, K, n_roots = self._max_depth, self._K, self._n_roots
         tree_chunk = self._tree_chunk
+
+        if self._fused:
+            def fn(stack, group, X, cut_values, base):
+                return predict_margin_fused(stack, group, X, cut_values,
+                                            base, max_depth, K,
+                                            n_roots=n_roots,
+                                            tree_chunk=tree_chunk)
+            return fn
 
         def fn(stack, group, binned, base):
             return predict_margin_binned(stack, group, binned, base,
@@ -144,7 +185,8 @@ class PredictEngine:
         return fn
 
     def _executable(self, bucket: int):
-        """The AOT-compiled margin executable for one row bucket."""
+        """The AOT-compiled margin executable for one row bucket (fused:
+        raw f32 rows + cut matrix in; two-step: pre-binned ids in)."""
         exe = self._compiled.get(bucket)
         if exe is not None:
             return exe
@@ -153,12 +195,20 @@ class PredictEngine:
             if exe is not None:
                 return exe
             import jax
-            binned_aval = jax.ShapeDtypeStruct(
-                (bucket, self.cuts.num_feature), self._bin_dtype)
             base_aval = jax.ShapeDtypeStruct(
                 (bucket, self._K), np.float32)
-            exe = jax.jit(self._margin_fn()).lower(
-                self._stack, self._group, binned_aval, base_aval).compile()
+            if self._fused:
+                x_aval = jax.ShapeDtypeStruct(
+                    (bucket, self.cuts.num_feature), np.float32)
+                exe = jax.jit(self._margin_fn()).lower(
+                    self._stack, self._group, x_aval, self._cuts_dev,
+                    base_aval).compile()
+            else:
+                binned_aval = jax.ShapeDtypeStruct(
+                    (bucket, self.cuts.num_feature), self._bin_dtype)
+                exe = jax.jit(self._margin_fn()).lower(
+                    self._stack, self._group, binned_aval,
+                    base_aval).compile()
             self.compile_count += 1
             if self.metrics is not None:
                 self.metrics.compiles.inc()
@@ -224,10 +274,19 @@ class PredictEngine:
             parts = [self.predict(X[i:i + top], output_margin)
                      for i in range(0, n, top)]
             return np.concatenate(parts, axis=0)
-        binned = self._bin(X)
         bucket = self.bucket_for(n)
-        if bucket > n:
-            binned = np.pad(binned, ((0, bucket - n), (0, 0)))
+        if self._fused:
+            # raw f32 rows upload; quantize happens IN the executable.
+            # Padding (rows and missing columns) is NaN -> bin 0,
+            # matching the two-step path's zero-bin padding.
+            payload = pad_to_width(X, self.num_feature)
+            if bucket > n:
+                payload = np.pad(payload, ((0, bucket - n), (0, 0)),
+                                 constant_values=np.nan)
+        else:
+            payload = self._bin(X)
+            if bucket > n:
+                payload = np.pad(payload, ((0, bucket - n), (0, 0)))
         if self.metrics is not None:
             self.metrics.rows.inc(n)
             self.metrics.padded_rows.inc(bucket - n)
@@ -240,19 +299,63 @@ class PredictEngine:
         # dispatch — the transform right after would sync here anyway.
         # Warmup traffic is suppressed like the ServingMetrics row
         # counters (phantom rows + warm-path cache effects).
-        from xgboost_tpu.obs import span
+        from xgboost_tpu.obs.metrics import (predict_metrics,
+                                             timed_device_put)
+        pm = None if self._warming else predict_metrics()
+        exe = self._executable(bucket)
+        # the batch upload stays OUTSIDE the timed traversal region and
+        # is blocked on + accounted separately (transfer counters): the
+        # chunk histogram must attribute TRAVERSAL, not transfer — the
+        # cost split the transfer-wall work exists to pin
+        dev = timed_device_put(
+            payload, pm.observe_transfer if pm is not None else None)
+        return self._margin_out(exe, dev, bucket, n, output_margin,
+                                pm, transfer_bytes=payload.nbytes)
+
+    def predict_resident(self, X_dev, n: int,
+                         output_margin: bool = False) -> np.ndarray:
+        """Predict a DEVICE-resident ``(bucket, F)`` f32 block with ZERO
+        host→device feature bytes — the feature-store fast path
+        (serving/featurestore.py): rows were uploaded once at ``put``
+        time, gathered on device by entity id, and quantize+traverse
+        runs in the same AOT bucket executables ``predict`` uses, so
+        results are bit-identical to uploading the same rows.  Rows
+        past ``n`` are padding (NaN rows -> bin 0), sliced off
+        host-side.  The block's row count must be a warmed bucket
+        (callers pad via :meth:`bucket_for`) — steady state stays
+        zero-compile AND zero-upload."""
+        bucket = int(X_dev.shape[0])
+        if self.metrics is not None:
+            self.metrics.rows.inc(n)
+            self.metrics.padded_rows.inc(bucket - n)
         from xgboost_tpu.obs.metrics import predict_metrics
         pm = None if self._warming else predict_metrics()
         exe = self._executable(bucket)
-        # the batch upload stays OUTSIDE the timed region too: the
-        # histogram must attribute TRAVERSAL, not transfer (the cost
-        # split this round exists to pin)
-        binned_dev = self._jnp.asarray(binned)
+        if not self._fused:
+            # two-step engines quantize ON DEVICE (eager, outside the
+            # executable) — still zero feature upload
+            from xgboost_tpu.binning import bin_dense_device
+            X_dev = bin_dense_device(X_dev, self._cuts_dev)
+        return self._margin_out(exe, X_dev, bucket, n, output_margin,
+                                pm, transfer_bytes=0)
+
+    def _margin_out(self, exe, operand, bucket: int, n: int,
+                    output_margin: bool, pm,
+                    transfer_bytes: int) -> np.ndarray:
+        """Run one bucket executable and transform: the shared tail of
+        ``predict`` (host batch) and ``predict_resident`` (store rows).
+        """
+        from xgboost_tpu.obs import span
         with span("serve.predict", rows=n, bucket=bucket,
-                  chunk=self._tree_chunk, chunks=self._n_chunks):
+                  chunk=self._tree_chunk, chunks=self._n_chunks,
+                  fused=self._fused, transfer_bytes=transfer_bytes):
             t0 = _time.perf_counter()
-            margin = exe(self._stack, self._group, binned_dev,
-                         self._base_for(bucket))
+            if self._fused:
+                margin = exe(self._stack, self._group, operand,
+                             self._cuts_dev, self._base_for(bucket))
+            else:
+                margin = exe(self._stack, self._group, operand,
+                             self._base_for(bucket))
             self._jax.block_until_ready(margin)
             if pm is not None:
                 pm.chunk_seconds.observe(
@@ -274,10 +377,8 @@ class PredictEngine:
         bin 0), width-padded to the model's feature count."""
         from xgboost_tpu.binning import bin_matrix
         from xgboost_tpu.data import DMatrix
-        if X.shape[1] < self.num_feature:
-            X = np.pad(X, ((0, 0), (0, self.num_feature - X.shape[1])),
-                       constant_values=np.nan)
-        return bin_matrix(DMatrix(X), self.cuts)
+        return bin_matrix(DMatrix(pad_to_width(X, self.num_feature)),
+                          self.cuts)
 
     # ------------------------------------------------------------- info
     @property
@@ -294,4 +395,5 @@ class PredictEngine:
             "objective": self.booster.param.objective,
             "tree_chunk": self._tree_chunk,
             "tree_chunks": self._n_chunks,
+            "fused": self._fused,
         }

@@ -7,6 +7,14 @@ Endpoints (SERVING.md):
   ``{"predictions": [...], "model_version": v, "rows": n}``.
   ``?output_margin=1`` returns raw margins.  A full batch queue maps to
   HTTP 503 (the batcher's reject-with-backpressure contract).
+- ``POST /predict_by_id`` — JSON ``{"ids": [...]}``: predictions for
+  DEVICE-RESIDENT entities (serving/featurestore.py) with zero
+  host→device feature bytes; absent ids → 404 listing them.  Enabled
+  by ``serve_featurestore_mb > 0``.
+- ``POST /featurestore/put`` — JSON ``{"ids": [...], "rows": [[...]]}``
+  pins entity rows on device (LRU-evicting past the byte budget);
+  ``POST /featurestore/invalidate`` — ``{"ids": [...]}`` or
+  ``{"all": true}`` drops them.
 - ``GET /healthz`` — liveness + model version + queue depth + p50/p99,
   plus the failure-path fields (RELIABILITY.md): drain ``state``,
   ``status: degraded`` while the watched model file is poisoned,
@@ -139,7 +147,7 @@ class _Handler(BaseHTTPRequestHandler):
             # "degraded" = still serving, but the watched file is
             # poisoned (its newest bytes cannot be loaded) — alerts fire
             # while traffic keeps flowing on the last good model
-            self._send_json(200, {
+            health = {
                 "status": "degraded" if reg.poisoned else "ok",
                 "state": ps.state,
                 "model_version": reg.version,
@@ -151,7 +159,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "last_reload_error": reg.last_reload_error,
                 "latency_p50_ms": round(q[0.5] * 1e3, 3),
                 "latency_p99_ms": round(q[0.99] * 1e3, 3),
-            })
+            }
+            if ps.featurestore is not None:
+                health["featurestore_rows"] = len(ps.featurestore)
+            self._send_json(200, health)
             return
         if url.path == "/metrics":
             # the full Prometheus exposition content type (scrapers key
@@ -197,6 +208,28 @@ class _Handler(BaseHTTPRequestHandler):
         body = self.rfile.read(length).decode("utf-8", "replace")
         if url.path == "/predict":
             self._predict(url, body)
+            return
+        if url.path == "/predict_by_id":
+            self._predict_by_id(url, body)
+            return
+        if url.path in ("/featurestore/put", "/featurestore/invalidate"):
+            # the mutating store routes pass the same drain admission
+            # gate as predictions: a draining server must not accept
+            # new device uploads, and in-flight ones must be visible to
+            # the inflight counter the drain waits on
+            ps: PredictServer = self.server.pserver
+            if not ps.enter_request():
+                self.close_connection = True
+                self._send_json(503, {"error": "server is draining",
+                                      "state": ps.state})
+                return
+            try:
+                if url.path == "/featurestore/put":
+                    self._featurestore_put(body)
+                else:
+                    self._featurestore_invalidate(body)
+            finally:
+                ps.exit_request()
             return
         if url.path == "/-/reload":
             # forced: bypasses the poisoned-fingerprint skip, so an
@@ -295,6 +328,150 @@ class _Handler(BaseHTTPRequestHandler):
                               "rows": int(X.shape[0])})
 
 
+    # -------------------------------------------------- feature store
+    def _store(self):
+        """The server's FeatureStore, or None + a 404 already sent."""
+        store = self.server.pserver.featurestore
+        if store is None:
+            self._send_json(404, {
+                "error": "feature store disabled "
+                         "(start with serve_featurestore_mb > 0)"})
+        return store
+
+    def _predict_by_id(self, url, body: str) -> None:
+        """Zero-upload prediction for device-resident entities: the
+        repeat-traffic fast path (SERVING.md feature store)."""
+        rid = self.headers.get("X-Request-Id") or trace.new_id()
+        self._request_id = rid
+        ps: PredictServer = self.server.pserver
+        if not ps.enter_request():
+            self.close_connection = True
+            self._send_json(503, {"error": "server is draining",
+                                  "state": ps.state})
+            return
+        try:
+            with trace_context(rid):
+                with span("serve.request", request_id=rid,
+                          by_id=True) as sp:
+                    self._predict_by_id_admitted(url, body, sp)
+        finally:
+            ps.exit_request()
+
+    def _predict_by_id_admitted(self, url, body: str, sp=None) -> None:
+        from xgboost_tpu.serving.featurestore import (FeatureStoreMiss,
+                                                      predict_by_id)
+
+        def _st(code: int) -> None:
+            if sp is not None:
+                sp.set("status", code)
+        store = self._store()
+        if store is None:
+            _st(404)
+            return
+        try:
+            qs = parse_qs(url.query)
+            output_margin = qs.get("output_margin",
+                                   ["0"])[0] in ("1", "true")
+            req = json.loads(body)
+            ids = req["ids"]
+            if not isinstance(ids, list) or not ids:
+                raise ValueError("'ids' must be a non-empty list")
+            om = req.get("output_margin", output_margin)
+            # same truthiness contract as the query string: "0"/"false"
+            # must DISABLE margins (bool("0") is True)
+            output_margin = (om is True or om == 1
+                             or str(om).lower() in ("1", "true"))
+        except (ValueError, KeyError, TypeError) as e:
+            _st(400)
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        if sp is not None:
+            sp.set("rows", len(ids))
+        reg: ModelRegistry = self.server.registry
+        # (version, engine) resolved atomically: the response names the
+        # model that actually ran, across hot-reloads — and a reload's
+        # new cuts rebin the SAME resident raw rows on device.  A
+        # reload that changed the FEATURE WIDTH swaps the store (empty,
+        # same budget): these ids then 404 as misses, not shape errors
+        version, engine = reg.current()
+        store = self.server.pserver.featurestore_for()
+        if store.num_feature != engine.num_feature:
+            # the engine snapshot raced a width-changing reload:
+            # re-resolve once (the store swap keyed on the registry's
+            # CURRENT engine, so the fresh snapshot matches it)
+            version, engine = reg.current()
+        if store.num_feature != engine.num_feature:
+            _st(503)
+            self._send_json(503, {
+                "error": "model reloading (feature width changed) — "
+                         "retry"})
+            return
+        try:
+            preds = predict_by_id(engine, store, ids,
+                                  output_margin=output_margin)
+        except FeatureStoreMiss as e:
+            _st(404)
+            self._send_json(404, {"error": str(e), "missing": e.missing})
+            return
+        except Exception as e:
+            _st(500)
+            self._send_json(500, {"error": str(e)})
+            return
+        _st(200)
+        if sp is not None:
+            sp.set("model_version", int(version))
+        self._send_json(200, {"predictions": np.asarray(preds).tolist(),
+                              "model_version": version,
+                              "rows": len(ids)})
+
+    def _featurestore_put(self, body: str) -> None:
+        store = self._store()
+        if store is None:
+            return
+        # puts validate against the CURRENT model's width (a width-
+        # changing hot-reload swaps in a fresh store of the new width)
+        store = self.server.pserver.featurestore_for()
+        try:
+            req = json.loads(body)
+            ids, rows = req["ids"], req["rows"]
+            if (not isinstance(ids, list) or not ids
+                    or not isinstance(rows, list)):
+                raise ValueError("'ids' and 'rows' must be lists")
+            X = np.asarray(rows, np.float32)
+            res = store.put(ids, X)
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        except Exception as e:
+            # device failure during the upload/scatter: put committed
+            # nothing (staged slot math) — surface it, don't drop the
+            # socket with a handler traceback
+            self._send_json(500, {"error": str(e)})
+            return
+        res.update(store.describe())
+        self._send_json(200, res)
+
+    def _featurestore_invalidate(self, body: str) -> None:
+        store = self._store()
+        if store is None:
+            return
+        try:
+            req = json.loads(body) if body.strip() else {}
+            if req.get("all"):
+                dropped = store.invalidate()
+            else:
+                ids = req.get("ids")
+                if not isinstance(ids, list) or not ids:
+                    raise ValueError(
+                        "pass {'ids': [...]} or {'all': true}")
+                dropped = store.invalidate(ids)
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        self._send_json(200, {"invalidated": dropped,
+                              "resident_rows": len(store)})
+
+
 class PredictServer:
     """Bundles registry + batcher + metrics behind ThreadingHTTPServer.
 
@@ -312,10 +489,17 @@ class PredictServer:
     def __init__(self, registry: ModelRegistry, batcher: MicroBatcher,
                  metrics, host: str = "127.0.0.1", port: int = 8080,
                  quiet: bool = True, drain_grace: float = 30.0,
-                 max_body_mb: float = 64.0):
+                 max_body_mb: float = 64.0, featurestore=None):
         self.registry = registry
         self.batcher = batcher
         self.metrics = metrics
+        # optional device-resident FeatureStore (serving/featurestore.py)
+        # backing /predict_by_id and the /featurestore/* admin routes;
+        # access through featurestore_for() on model-facing paths so a
+        # hot-reload that CHANGES THE FEATURE WIDTH swaps in a fresh
+        # store instead of feeding wrong-width rows to the new engine
+        self.featurestore = featurestore
+        self._fs_lock = threading.Lock()
         self.drain_grace = float(drain_grace)
         self.max_body_bytes = int(max_body_mb * (1 << 20))
         # /healthz uptime_seconds: perf_counter — uptime is a duration,
@@ -338,6 +522,39 @@ class PredictServer:
         self._httpd.pserver = self
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------ feature store
+    def featurestore_for(self):
+        """The live FeatureStore, re-created (same byte budget, empty)
+        when the registry's CURRENT engine has a different feature
+        width than the store.
+
+        Raw-row storage makes cut/max_bin hot-reloads free (the next
+        predict_by_id rebins resident rows on device), but a reload to
+        a DIFFERENT FEATURE COUNT makes every resident row meaningless
+        for the new model — the swap drops them, and callers see
+        404-miss (re-``put`` with new-width features), never a
+        shape-mismatched executable call.  The swap keys on the
+        registry's current engine, NOT any caller's resolved snapshot:
+        a request still in flight across the reload must not wipe a
+        store that has already been re-populated at the new width."""
+        store = self.featurestore
+        if store is None:
+            return None
+        width = self.registry.engine.num_feature
+        if store.num_feature == width:
+            return store
+        with self._fs_lock:
+            store = self.featurestore
+            width = self.registry.engine.num_feature
+            if store.num_feature != width:
+                from xgboost_tpu.obs.metrics import featurestore_metrics
+                from xgboost_tpu.serving.featurestore import FeatureStore
+                store = FeatureStore(
+                    width, budget_mb=store.budget_bytes / (1 << 20))
+                self.featurestore = store
+                featurestore_metrics().resident_bytes.set(0)
+        return store
 
     # -------------------------------------------------------- drain state
     @property
@@ -448,9 +665,15 @@ def run_server(model_path: str, host: str = "127.0.0.1", port: int = 8080,
                max_queue_rows: int = 8192, poll_sec: float = 1.0,
                keep_versions: int = 2, warmup: bool = True,
                drain_sec: float = 30.0, max_body_mb: float = 64.0,
+               featurestore_mb: float = 0.0,
                quiet: bool = False,
                block: bool = True) -> Optional[PredictServer]:
     """Build the full serving stack for one model file and run it.
+
+    ``featurestore_mb > 0`` attaches a device-resident
+    :class:`~xgboost_tpu.serving.featurestore.FeatureStore` of that
+    byte budget, enabling ``POST /predict_by_id`` (zero-upload repeat
+    traffic) and the ``/featurestore/*`` admin routes.
 
     With ``block=False`` the server runs on a background thread and the
     :class:`PredictServer` is returned (tests, embedding)."""
@@ -463,9 +686,14 @@ def run_server(model_path: str, host: str = "127.0.0.1", port: int = 8080,
     batcher = MicroBatcher(registry.predict, max_batch_rows=max_batch_rows,
                            max_wait_ms=max_wait_ms,
                            max_queue_rows=max_queue_rows, metrics=metrics)
+    store = None
+    if featurestore_mb > 0:
+        from xgboost_tpu.serving.featurestore import FeatureStore
+        store = FeatureStore(registry.engine.num_feature,
+                             budget_mb=featurestore_mb)
     server = PredictServer(registry, batcher, metrics, host=host, port=port,
                            quiet=quiet, drain_grace=drain_sec,
-                           max_body_mb=max_body_mb)
+                           max_body_mb=max_body_mb, featurestore=store)
     if not quiet:
         eng = registry.engine
         print(f"[serving] model {model_path} (v{registry.version}, "
