@@ -19,6 +19,14 @@ Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_loop.py --runs 10 --seed 0
 
+``--fleet`` switches to the SERVING-tier chaos mode (SERVING.md fleet
+section): a local fleet (tools/launch_fleet.py — router + N replica
+subprocesses) serves live traffic while a killer SIGKILLs a random
+replica every few seconds and keepalive restarts it.  The assertion is
+the fleet contract: ZERO failed non-shed requests — every client
+request either succeeds (the router's retry-once path absorbs replica
+deaths) or is an explicit 503 shed.  Emits ``CHAOS_fleet.json``.
+
 Also runs as a slow-marked test
 (tests/test_reliability.py::test_chaos_loop_driver).
 """
@@ -30,6 +38,7 @@ import json
 import os
 import sys
 import tempfile
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -58,15 +67,148 @@ def _states_equal(a, b) -> bool:
     return all(np.array_equal(a[k], b[k]) for k in a)
 
 
+def fleet_mode(args) -> int:
+    """Replica-kill chaos against a live local fleet: random SIGKILLs
+    mid-traffic + keepalive restarts; asserts zero non-shed request
+    failures (the router retry contract)."""
+    import threading
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from launch_fleet import FleetLauncher, RetryingPredictClient
+
+    import xgboost_tpu as xgb
+
+    work = args.workdir or tempfile.mkdtemp(prefix="xgbtpu_chaosfleet_")
+    os.makedirs(work, exist_ok=True)
+    rng = np.random.RandomState(args.seed)
+    X = rng.rand(400, 6).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "eta": 0.4, "silent": 1},
+                    xgb.DMatrix(X, label=y), 4)
+    model = os.path.join(work, "model.bin")
+    bst.save_model(model)
+
+    fl = FleetLauncher(
+        model, replicas=args.fleet_replicas,
+        workdir=os.path.join(work, "fleet"),
+        serve_args=["serve_min_bucket=8", "serve_max_bucket=32",
+                    "serve_max_wait_ms=1.0"],
+        # short lease + fast health checks: a killed replica leaves
+        # rotation quickly even before its breaker trips
+        router_kwargs={"lease_sec": 3.0, "hc_sec": 0.5},
+        quiet=True)
+    fl.start()
+    try:
+        print(f"[chaos-fleet] waiting for {args.fleet_replicas} "
+              "replicas...", file=sys.stderr)
+        fl.wait_ready()
+    except BaseException:
+        # a failed bring-up must not orphan the router thread + N
+        # replica subprocesses
+        fl.stop()
+        raise
+
+    body = ",".join(f"{v:.6f}" for v in X[0]).encode()
+    counts = {"ok": 0, "shed": 0, "fail": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        # retry-once keep-alive client (launch_fleet): a second
+        # transport failure counts as a REAL failure — the router is
+        # up throughout, only replicas get killed
+        conn = RetryingPredictClient(fl.url)
+        mine = {"ok": 0, "shed": 0, "fail": 0}
+        while not stop.is_set():
+            status, _detail = conn.post(body)
+            if status == 200:
+                mine["ok"] += 1
+            elif status == 503:
+                mine["shed"] += 1
+            else:
+                mine["fail"] += 1
+        conn.close()
+        with lock:
+            for k in counts:
+                counts[k] += mine[k]
+
+    clients = [threading.Thread(target=client) for _ in range(4)]
+    for t in clients:
+        t.start()
+
+    kills = 0
+    t_end = time.perf_counter() + args.fleet_secs
+    next_kill = time.perf_counter() + args.kill_every
+    try:
+        while time.perf_counter() < t_end:
+            time.sleep(0.25)
+            fl.reap_and_restart()  # keepalive
+            if time.perf_counter() >= next_kill:
+                # victims come from the IN-ROTATION set (the router's
+                # view — an alive-but-still-warming restart is not a
+                # serving replica), and only while at least two are in
+                # rotation: the contract under test is "replica deaths
+                # cost nothing" — killing the LAST serving replica
+                # (restarts take seconds) is a whole-fleet outage,
+                # where 5xx is the only honest answer
+                try:
+                    rotation = [m["replica_id"]
+                                for m in fl.members()["replicas"]
+                                if m["in_rotation"]]
+                except OSError:
+                    rotation = []
+                if len(rotation) >= 2:
+                    victim = int(
+                        rotation[rng.randint(len(rotation))][1:])
+                    if fl.kill_replica(victim) is not None:
+                        kills += 1
+                        print(f"[chaos-fleet] killed replica r{victim}",
+                              file=sys.stderr)
+                next_kill = time.perf_counter() + args.kill_every
+    finally:
+        stop.set()
+        for t in clients:
+            t.join(30.0)
+        restarts = fl.restarts
+        fl.stop()
+
+    report = {"mode": "fleet", "replicas": args.fleet_replicas,
+              "duration_sec": args.fleet_secs, "kills": kills,
+              "keepalive_restarts": restarts, **counts,
+              "non_shed_failures": counts["fail"]}
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"[chaos-fleet] {counts['ok']} ok, {counts['shed']} shed, "
+          f"{counts['fail']} FAILED across {kills} kills / "
+          f"{restarts} restarts -> {args.out}", file=sys.stderr)
+    if counts["fail"] or kills == 0 or not counts["ok"]:
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument("--rounds", type=int, default=6)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--out", default="CHAOS.json")
+    ap.add_argument("--out", default=None)
     ap.add_argument("--workdir", default=None,
                     help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="serving-tier mode: kill/restart replicas "
+                         "under live traffic (see module docstring)")
+    ap.add_argument("--fleet-replicas", type=int, default=3)
+    ap.add_argument("--fleet-secs", type=float, default=20.0,
+                    help="--fleet: how long to drive traffic")
+    ap.add_argument("--kill-every", type=float, default=4.0,
+                    help="--fleet: seconds between replica kills")
     args = ap.parse_args(argv)
+    if args.out is None:
+        args.out = "CHAOS_fleet.json" if args.fleet else "CHAOS.json"
+    if args.fleet:
+        return fleet_mode(args)
 
     from xgboost_tpu.cli import main as cli_main
     from xgboost_tpu.profiling import reliability_metrics

@@ -212,7 +212,8 @@ class HostSyncInHotLoop(Rule):
     name = "host-sync-in-hot-loop"
 
     HOT_PATHS = ("models/gbtree.py", "models/updaters.py", "ops/",
-                 "serving/engine.py", "serving/featurestore.py")
+                 "serving/engine.py", "serving/featurestore.py",
+                 "fleet/")
 
     def applies(self, path: str) -> bool:
         return _path_has(path, self.HOT_PATHS)

@@ -28,7 +28,8 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
-from xgboost_tpu.config import SERVE_PARAMS, parse_config_file
+from xgboost_tpu.config import (FLEET_PARAMS, SERVE_PARAMS,
+                                parse_config_file)
 
 # process start, for recovery-cost accounting.  perf_counter, not
 # wall-clock: these readings are only ever subtracted (XGT006)
@@ -44,6 +45,12 @@ Tasks (task=...):
   dump    dump trees as text (model_in=..., name_dump=...)
   serve   HTTP prediction service (model_in=...; see parameters below,
           or `python -m xgboost_tpu.serving --help`)
+  fleet_router
+          fleet front door (xgboost_tpu.fleet, SERVING.md): replicas
+          started with serve_router_url=... register here; dispatch is
+          least-loaded (/predict) or consistent-hash (/predict_by_id),
+          with circuit breakers, load shedding, and canary rollout
+          (quickstart: tools/launch_fleet.py)
 
 Observability (OBSERVABILITY.md): obs_log=PATH appends a crash-safe
 JSONL timeline (render: tools/obs_report.py); metrics_port=N serves
@@ -51,6 +58,9 @@ live /metrics + /healthz during task=train (0 = ephemeral, -1 = off).
 
 task=serve parameters:
 {serve_params}
+
+task=fleet_router parameters:
+{fleet_params}
 """
 
 
@@ -87,8 +97,10 @@ class BoostLearnTask:
         self.eval_names: List[str] = []
         self.eval_paths: List[str] = []
         self.learner_params: List[Tuple[str, str]] = []
-        # task=serve knobs, seeded from config.SERVE_PARAMS defaults
+        # task=serve / task=fleet_router knobs, seeded from the config
+        # tables (single source of truth for both CLI surfaces)
         self.serve_params = {k: v for k, (v, _) in SERVE_PARAMS.items()}
+        self.fleet_params = {k: v for k, (v, _) in FLEET_PARAMS.items()}
 
     # ------------------------------------------------------------- params
     _OWN = {
@@ -149,6 +161,8 @@ class BoostLearnTask:
             self.faults_spec = val
         elif name in self.serve_params:
             self.serve_params[name] = type(SERVE_PARAMS[name][0])(val)
+        elif name in self.fleet_params:
+            self.fleet_params[name] = type(FLEET_PARAMS[name][0])(val)
         else:
             m = re.match(r"eval\[([^\]]+)\]", name)
             if m:
@@ -162,8 +176,10 @@ class BoostLearnTask:
     # --------------------------------------------------------------- run
     def run(self, argv: List[str]) -> int:
         if not argv:
-            from xgboost_tpu.config import serve_params_help
-            print(_USAGE.format(serve_params=serve_params_help()))
+            from xgboost_tpu.config import (fleet_params_help,
+                                            serve_params_help)
+            print(_USAGE.format(serve_params=serve_params_help(),
+                                fleet_params=fleet_params_help()))
             return 0
         if os.path.exists(argv[0]) or "=" not in argv[0]:
             for name, val in parse_config_file(argv[0]):
@@ -301,6 +317,8 @@ class BoostLearnTask:
             return self.task_dump()
         if self.task == "serve":
             return self.task_serve()
+        if self.task == "fleet_router":
+            return self.task_fleet_router()
         raise ValueError(f"unknown task {self.task!r}")
 
     # ------------------------------------------------------------- helpers
@@ -518,6 +536,34 @@ class BoostLearnTask:
             drain_sec=sp["serve_drain_sec"],
             max_body_mb=sp["serve_max_body_mb"],
             featurestore_mb=sp["serve_featurestore_mb"],
+            router_url=sp["serve_router_url"],
+            replica_id=sp["serve_replica_id"],
+            advertise_url=sp["serve_advertise_url"],
+            quiet=self.silent != 0, block=True)
+        return 0
+
+    # ------------------------------------------------------- fleet_router
+    def task_fleet_router(self) -> int:
+        """Run the fleet routing front door (xgboost_tpu.fleet,
+        SERVING.md fleet section).  Replicas join with
+        ``task=serve serve_router_url=http://host:port``."""
+        from xgboost_tpu.fleet import run_router
+        fp = self.fleet_params
+        run_router(
+            host=fp["fleet_host"], port=fp["fleet_port"],
+            lease_sec=fp["fleet_lease_sec"], hc_sec=fp["fleet_hc_sec"],
+            inflight_budget=fp["fleet_inflight"],
+            breaker_failures=fp["fleet_breaker_failures"],
+            breaker_cooldown_sec=fp["fleet_breaker_cooldown_sec"],
+            retry=bool(fp["fleet_retry"]),
+            forward_timeout=fp["fleet_timeout_sec"],
+            max_body_mb=fp["fleet_max_body_mb"],
+            rollout_defaults={
+                "canaries": fp["fleet_canaries"],
+                "soak_sec": fp["fleet_soak_sec"],
+                "gate_error_rate": fp["fleet_gate_error_rate"],
+                "gate_p99_ms": fp["fleet_gate_p99_ms"],
+            },
             quiet=self.silent != 0, block=True)
         return 0
 

@@ -275,6 +275,18 @@ SERVE_PARAMS: Dict[str, Tuple[Any, str]] = {
                                    "hot-entity feature store backing "
                                    "POST /predict_by_id (0 disables; "
                                    "LRU-evicts past the budget)"),
+    "serve_router_url": ("", "fleet router base URL (e.g. "
+                             "http://127.0.0.1:8000); the replica "
+                             "registers there and renews a heartbeat "
+                             "lease (empty = standalone, no fleet)"),
+    "serve_replica_id": ("", "stable replica identity used with the "
+                            "fleet router (default host:port; a "
+                            "restarted replica re-registering under "
+                            "its old id is the recover path)"),
+    "serve_advertise_url": ("", "endpoint the router should dial for "
+                                "this replica (default the bind "
+                                "address; REQUIRED for cross-host "
+                                "fleets binding 0.0.0.0)"),
 }
 
 
@@ -282,6 +294,51 @@ def serve_params_help() -> str:
     """One line per task=serve parameter, for CLI usage text."""
     return "\n".join(f"  {name:<22} {help_} (default {default!r})"
                      for name, (default, help_) in SERVE_PARAMS.items())
+
+
+# --------------------------------------------------------------- fleet
+# task=fleet_router parameters (xgboost_tpu.fleet) — same single-table
+# discipline as SERVE_PARAMS: the classic CLI derives its surface from
+# this dict, so usage text stays complete as knobs are added.
+FLEET_PARAMS: Dict[str, Tuple[Any, str]] = {
+    "fleet_host": ("127.0.0.1", "bind address for the router"),
+    "fleet_port": (8000, "router HTTP port (0 = ephemeral, printed at "
+                         "startup)"),
+    "fleet_lease_sec": (10.0, "replica heartbeat lease: a replica that "
+                              "stops renewing leaves rotation within "
+                              "this window"),
+    "fleet_hc_sec": (2.0, "health-check interval: the router probes "
+                          "each replica's /healthz (draining/degraded "
+                          "replicas leave rotation; 0 disables)"),
+    "fleet_inflight": (256, "global in-flight request budget; requests "
+                            "past it are shed with HTTP 503"),
+    "fleet_breaker_failures": (3, "consecutive dispatch failures that "
+                                  "trip a replica's circuit breaker "
+                                  "open"),
+    "fleet_breaker_cooldown_sec": (5.0, "seconds an open breaker waits "
+                                        "before allowing one half-open "
+                                        "probe request"),
+    "fleet_retry": (1, "retry a failed /predict once on a different "
+                       "healthy replica (predictions are idempotent)"),
+    "fleet_timeout_sec": (30.0, "per-hop forward timeout to a replica"),
+    "fleet_max_body_mb": (64.0, "largest accepted request body (413 "
+                                "past it, before buffering)"),
+    "fleet_canaries": (1, "default canary replica count for POST "
+                          "/fleet/rollout"),
+    "fleet_soak_sec": (3.0, "default canary soak window before the "
+                            "rollout gate reads canary /metrics"),
+    "fleet_gate_error_rate": (0.02, "rollout gate: max canary error "
+                                    "rate (errors/requests) during the "
+                                    "soak"),
+    "fleet_gate_p99_ms": (250.0, "rollout gate: max canary p99 request "
+                                 "latency in milliseconds"),
+}
+
+
+def fleet_params_help() -> str:
+    """One line per task=fleet_router parameter, for CLI usage text."""
+    return "\n".join(f"  {name:<26} {help_} (default {default!r})"
+                     for name, (default, help_) in FLEET_PARAMS.items())
 
 
 def parse_config_file(path: str) -> List[Tuple[str, str]]:

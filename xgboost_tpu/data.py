@@ -23,6 +23,7 @@ route all distributed/external training through histogram updaters
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -114,6 +115,15 @@ class DMatrix:
 
     Accepts: libsvm text path, dense numpy array (with ``missing`` marker),
     scipy CSR/CSC, or a (indptr, indices, values, num_col) CSR tuple.
+
+    Dense ndarray input is held by REFERENCE and CSR is built lazily on
+    first ``values``/``indices``/``indptr`` access (a one-off predict
+    never builds it — the fused path uploads views of the caller's
+    buffer).  Consequence: mutating the source array between
+    construction and first use changes what this matrix sees — and only
+    for float32 input (``np.asarray`` copies while converting any other
+    dtype); snapshot with ``DMatrix(arr.copy())`` when the buffer will
+    be reused.
     """
 
     def __new__(cls, data: Any = None, *args, **kwargs):
@@ -154,6 +164,17 @@ class DMatrix:
         self.info = MetaInfo()
         self.feature_names = list(feature_names) if feature_names else None
         self._col_cache = None
+        # CSR storage is LAZY for dense ndarray input: a one-off
+        # ``DMatrix(arr)`` predict never touches values/indices/indptr
+        # (the fused path uploads views of ``arr`` itself and the
+        # density gate reads num_nonmissing()), so the ~2x host copy is
+        # only built when something actually iterates CSR (training,
+        # sparse binning, slicing...).  The properties below
+        # materialize on first access — transparent to every consumer.
+        self._indptr = self._indices = self._values = None
+        self._lazy_dense: Optional[tuple] = None  # (arr, missing)
+        self._lazy_lock = threading.Lock()
+        self._nnz: Optional[int] = None
 
         if isinstance(data, str):
             from xgboost_tpu.io.dispatch import load_dmatrix_into
@@ -173,7 +194,8 @@ class DMatrix:
             arr = np.asarray(data, dtype=np.float32)
             if arr.ndim != 2:
                 raise ValueError("expected 2D array")
-            self._from_dense(arr, missing)
+            self._lazy_dense = (arr, missing)
+            self._num_col = arr.shape[1]
 
         if num_col is not None:
             self._num_col = max(num_col, getattr(self, "_num_col", 0))
@@ -190,7 +212,9 @@ class DMatrix:
             self.info.set_field("group", group)
 
     # ------------------------------------------------------------------
-    def _from_dense(self, arr: np.ndarray, missing: float) -> None:
+    def _from_dense_locked(self, arr: np.ndarray, missing: float) -> None:
+        # called with _lazy_lock held (lazy materialization) — the one
+        # CSR-building path since dense __init__ went lazy
         if np.isnan(missing):
             present = ~np.isnan(arr)
         else:
@@ -202,9 +226,98 @@ class DMatrix:
         self.values = arr[rows, cols].astype(np.float32)
         self._num_col = arr.shape[1]
 
+    # ------------------------------------------------------- lazy CSR
+    def _materialize(self) -> None:
+        """Build CSR from the pending dense source, once, thread-safely
+        (an eagerly-built DMatrix was always shareable across predict
+        threads; lazy construction must not regress that).  Writes land
+        in order — arrays first, the ``_lazy_dense = None`` "done" mark
+        last — so a lock-free property read that sees the mark cleared
+        also sees complete arrays (GIL ordering)."""
+        with self._lazy_lock:
+            if self._lazy_dense is None:
+                return  # another thread won the race (or nothing lazy)
+            arr, missing = self._lazy_dense
+            nc = self._num_col  # num_col= widening must survive rebuild
+            self._from_dense_locked(arr, missing)
+            self._num_col = max(nc, self._num_col)
+            self._lazy_dense = None
+
+    @property
+    def indptr(self) -> np.ndarray:
+        if self._indptr is None:
+            self._materialize()
+        return self._indptr
+
+    @indptr.setter
+    def indptr(self, v) -> None:
+        self._indptr = v
+
+    @property
+    def indices(self) -> np.ndarray:
+        if self._indices is None:
+            self._materialize()
+        return self._indices
+
+    @indices.setter
+    def indices(self, v) -> None:
+        self._indices = v
+
+    @property
+    def values(self) -> np.ndarray:
+        if self._values is None:
+            self._materialize()
+        return self._values
+
+    @values.setter
+    def values(self, v) -> None:
+        self._values = v
+
+    def num_nonmissing(self) -> int:
+        """Count of stored (non-missing) entries — ``len(values)``
+        without forcing a lazy dense matrix to materialize CSR: the
+        predict-path density gate (learner.py) reads ONLY this, so a
+        dense one-off ``DMatrix(arr)`` routes straight to the fused
+        upload of ``arr`` itself.  Counted in bounded row blocks (the
+        boolean temp stays ~16 MB however large the matrix is);
+        bit-identical to ``len(self.values)`` by construction."""
+        src = self._lazy_dense  # one read: may be cleared concurrently
+        if self._values is not None or src is None:
+            return len(self.values)
+        if self._nnz is None:
+            arr, missing = src
+            block = max(1, (1 << 24) // max(arr.shape[1], 1))
+            total = 0
+            for s in range(0, arr.shape[0], block):
+                chunk = arr[s:s + block]
+                if np.isnan(missing):
+                    total += int(np.count_nonzero(~np.isnan(chunk)))
+                else:
+                    total += int(np.count_nonzero(chunk != missing))
+            self._nnz = total
+        return self._nnz
+
+    def predict_dense_src(self) -> Optional[np.ndarray]:
+        """The dense f32 NaN-missing buffer this matrix wraps, when CSR
+        is still pending — the zero-copy upload source for the fused
+        predict path (learner._dense_block_fn).  None once CSR exists
+        or when the missing marker / dtype / layout would change the
+        uploaded values."""
+        src = self._lazy_dense  # one read: may be cleared concurrently
+        if src is None:
+            return None
+        arr, missing = src
+        if (np.isnan(missing) and arr.dtype == np.float32
+                and arr.flags.c_contiguous):
+            return arr
+        return None
+
     # ------------------------------------------------------------------
     @property
     def num_row(self) -> int:
+        src = self._lazy_dense  # one read: may be cleared concurrently
+        if self._indptr is None and src is not None:
+            return int(src[0].shape[0])
         return len(self.indptr) - 1
 
     @property

@@ -1,0 +1,542 @@
+"""Replica membership: heartbeat leases, health, consistent hashing.
+
+The reference's tracker tier (``tracker/rabit_tracker.py``, SURVEY.md
+L0) is a rendezvous service: workers connect, get assigned a rank,
+report liveness, and a restarted worker sends ``recover`` to rejoin the
+job.  The serving-fleet analog lives here:
+
+- :class:`Membership` (router side) — replicas register over HTTP and
+  renew a **heartbeat lease**; a replica whose lease expires, whose
+  ``/healthz`` stops answering, or whose drain state machine left
+  ``serving`` drops out of rotation automatically.  A restarted replica
+  simply registers again under the same id — the ``recover`` path —
+  and is back in rotation on the next health pass.
+- :class:`HashRing` — consistent hashing for ``/predict_by_id``
+  dispatch: an entity id maps to the same replica across requests (so
+  device-resident feature rows concentrate there), and a membership
+  change remaps only the keys owned by the changed replica.
+- :class:`LeaseClient` (replica side) — the registration/heartbeat
+  client the HTTP server runs when ``serve_router_url`` is set; it
+  re-registers on lease loss and deregisters on drain.  The chaos
+  kinds ``heartbeat_loss`` / ``replica_kill`` (reliability/faults.py)
+  hook its loop, so fleet recovery is provable the same way checkpoint
+  recovery is.
+
+All lease arithmetic uses ``time.monotonic()`` — leases are durations,
+and an NTP step must not expire the whole fleet (XGT006).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+# breaker states (per replica, managed by Membership under its lock)
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half-open"
+
+
+class Replica:
+    """One registered replica: identity, lease, health, breaker, load.
+
+    All mutable fields are guarded by the owning :class:`Membership`'s
+    lock; read-mostly snapshots go out through ``describe()``."""
+
+    def __init__(self, replica_id: str, url: str,
+                 model_path: Optional[str] = None,
+                 model_hash: Optional[str] = None,
+                 pid: Optional[int] = None):
+        self.replica_id = replica_id
+        self.url = url.rstrip("/")
+        self.model_path = model_path
+        self.model_hash = model_hash
+        self.pid = pid
+        self.lease_deadline = 0.0       # monotonic
+        self.registered_count = 0       # bumps on every (re-)register
+        self.health_ok = True           # last /healthz verdict
+        self.health_state = "serving"   # replica's drain state
+        self.outstanding = 0            # requests in flight via router
+        # circuit breaker (consecutive-failure trip, half-open probe)
+        self.breaker = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self.breaker_opened_at = 0.0    # monotonic
+        self.probe_inflight = False
+
+    def lease_live(self, now: float) -> bool:
+        return now < self.lease_deadline
+
+    def describe(self, now: float) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "url": self.url,
+            "model_path": self.model_path,
+            "model_hash": self.model_hash,
+            "pid": self.pid,
+            "lease_remaining_sec": round(self.lease_deadline - now, 3),
+            "health_ok": self.health_ok,
+            "state": self.health_state,
+            "outstanding": self.outstanding,
+            "breaker": self.breaker,
+            "consecutive_failures": self.consecutive_failures,
+            "registered_count": self.registered_count,
+        }
+
+
+class HashRing:
+    """Consistent-hash ring over replica ids (virtual nodes).
+
+    ``route(key, eligible)`` walks clockwise from the key's point to
+    the first vnode whose replica is in ``eligible`` — so keys owned by
+    a dead/draining replica fail over to its ring successor while every
+    other key stays put (feature-store residency concentrates and
+    survives membership churn)."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        # (points, owners) swapped in ONE assignment so lock-free
+        # readers (route_ids hashes outside the membership lock) always
+        # see a consistent pair
+        self._nodes: tuple = ((), ())
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha1(key.encode("utf-8", "replace")).digest()[:8],
+            "big")
+
+    def rebuild(self, replica_ids: List[str]) -> None:
+        pts = []
+        for rid in replica_ids:
+            for v in range(self.vnodes):
+                pts.append((self._hash(f"{rid}#{v}"), rid))
+        pts.sort()
+        self._nodes = (tuple(p for p, _ in pts),
+                       tuple(r for _, r in pts))
+
+    def route(self, key: str, eligible) -> Optional[str]:
+        """First eligible replica clockwise from ``key``'s point."""
+        points, owners = self._nodes  # one read: rebuild swaps atomically
+        n = len(points)
+        if n == 0:
+            return None
+        start = bisect.bisect_left(points, self._hash(str(key)))
+        for i in range(n):
+            owner = owners[(start + i) % n]
+            if owner in eligible:
+                return owner
+        return None
+
+
+class Membership:
+    """The router's replica table: register/heartbeat/expire + health.
+
+    ``in_rotation()`` is the dispatch view: lease live, last health
+    check OK, drain state ``serving``.  The breaker is tracked here too
+    (it is per-replica state the dispatcher consults), with the classic
+    three states: CLOSED (normal) -> OPEN after
+    ``breaker_failures`` consecutive errors (no traffic) ->
+    HALF-OPEN after ``breaker_cooldown_sec`` (exactly one probe
+    request) -> CLOSED on success / OPEN again on failure."""
+
+    def __init__(self, lease_sec: float = 10.0,
+                 breaker_failures: int = 3,
+                 breaker_cooldown_sec: float = 5.0,
+                 vnodes: int = 64):
+        self.lease_sec = float(lease_sec)
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_cooldown_sec = float(breaker_cooldown_sec)
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Replica] = {}
+        self._ring = HashRing(vnodes)
+        self._ring_stale = True
+
+    # ---------------------------------------------------------- lifecycle
+    def register(self, replica_id: str, url: str,
+                 model_path: Optional[str] = None,
+                 model_hash: Optional[str] = None,
+                 pid: Optional[int] = None) -> dict:
+        """Add (or revive — the tracker ``recover`` path) a replica and
+        grant a heartbeat lease.  Returns the lease grant."""
+        from xgboost_tpu.obs import event
+        from xgboost_tpu.obs.metrics import fleet_metrics
+        now = time.monotonic()
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            recovered = rep is not None
+            if rep is None:
+                rep = Replica(replica_id, url, model_path, model_hash, pid)
+                self._replicas[replica_id] = rep
+            else:
+                # a restarted process re-registers under its old id:
+                # fresh endpoint/pid, breaker and health start clean
+                rep.url = url.rstrip("/")
+                rep.model_path = model_path or rep.model_path
+                rep.model_hash = model_hash or rep.model_hash
+                rep.pid = pid if pid is not None else rep.pid
+                rep.breaker = BREAKER_CLOSED
+                rep.consecutive_failures = 0
+                rep.probe_inflight = False
+                rep.outstanding = 0
+            rep.health_ok = True
+            rep.health_state = "serving"
+            rep.registered_count += 1
+            rep.lease_deadline = now + self.lease_sec
+            self._ring_stale = True
+            total = len(self._replicas)
+        fleet_metrics().members_registered.set(total)
+        event("fleet.register", replica_id=replica_id, url=url,
+              recovered=recovered)
+        return {"lease_sec": self.lease_sec, "recovered": recovered}
+
+    def heartbeat(self, replica_id: str,
+                  model_hash: Optional[str] = None) -> bool:
+        """Renew a lease.  False = unknown replica (the client should
+        re-register — its lease expired or the router restarted)."""
+        now = time.monotonic()
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                return False
+            rep.lease_deadline = now + self.lease_sec
+            if model_hash:
+                rep.model_hash = model_hash
+            return True
+
+    def deregister(self, replica_id: str) -> bool:
+        """Remove a replica (drain shutdown announces itself)."""
+        from xgboost_tpu.obs import event
+        from xgboost_tpu.obs.metrics import fleet_metrics
+        with self._lock:
+            rep = self._replicas.pop(replica_id, None)
+            self._ring_stale = True
+            total = len(self._replicas)
+        fleet_metrics().members_registered.set(total)
+        if rep is not None:
+            event("fleet.deregister", replica_id=replica_id)
+        return rep is not None
+
+    # ------------------------------------------------------------- views
+    def get(self, replica_id: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    def urls(self):
+        """Base URLs of every registered replica (any state) — the
+        router's connection pool prunes against this set."""
+        with self._lock:
+            return {r.url for r in self._replicas.values()}
+
+    def in_rotation(self) -> List[Replica]:
+        """Replicas eligible for dispatch: lease live, healthy,
+        drain state ``serving``.  (Breaker gating is separate — an
+        OPEN breaker blocks dispatch but a half-open probe may pass.)"""
+        now = time.monotonic()
+        with self._lock:
+            return [r for r in self._replicas.values()
+                    if r.lease_live(now) and r.health_ok
+                    and r.health_state == "serving"]
+
+    def describe(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            reps = [r.describe(now) for r in self._replicas.values()]
+        rotation = {r.replica_id for r in self.in_rotation()}
+        for d in reps:
+            d["in_rotation"] = d["replica_id"] in rotation
+        return {"replicas": sorted(reps, key=lambda d: d["replica_id"]),
+                "in_rotation": len(rotation),
+                "registered": len(reps)}
+
+    # ---------------------------------------------------------- dispatch
+    def _breaker_allows_locked(self, rep: Replica, now: float) -> bool:
+        if rep.breaker == BREAKER_CLOSED:
+            return True
+        if rep.breaker == BREAKER_OPEN:
+            if now - rep.breaker_opened_at < self.breaker_cooldown_sec:
+                return False
+            rep.breaker = BREAKER_HALF_OPEN
+            rep.probe_inflight = False
+        # half-open: exactly one probe request at a time
+        if rep.probe_inflight:
+            return False
+        rep.probe_inflight = True
+        return True
+
+    def acquire(self, exclude=()) -> Optional[Replica]:
+        """Pick the LEAST-LOADED dispatch target (fewest outstanding
+        requests) over in-rotation, breaker-permitting replicas and
+        count it as outstanding.  ``exclude`` removes replicas already
+        tried (the retry path).  Entity-id traffic uses
+        :meth:`acquire_specific` on the resolved ring owner instead.
+        Callers MUST pair with :meth:`release`."""
+        now = time.monotonic()
+        rotation = {r.replica_id for r in self.in_rotation()}
+        with self._lock:
+            candidates = [r for rid, r in self._replicas.items()
+                          if rid in rotation and rid not in exclude]
+            allowed = [r for r in candidates
+                       if self._breaker_allows_locked(r, now)]
+            # _breaker_allows_locked marks a half-open probe slot taken;
+            # give back the slots of candidates we do not pick
+            chosen: Optional[Replica] = None
+            if allowed:
+                chosen = min(allowed,
+                             key=lambda r: (r.outstanding,
+                                            r.replica_id))
+            for r in allowed:
+                if (r is not chosen and r.breaker == BREAKER_HALF_OPEN
+                        and r.probe_inflight):
+                    r.probe_inflight = False
+            if chosen is None:
+                return None
+            chosen.outstanding += 1
+            return chosen
+
+    def acquire_specific(self, replica_id: str) -> Optional[Replica]:
+        """Count a dispatch against ONE named replica (the router's
+        split-merge path already resolved ring ownership): in-rotation
+        and breaker-permitting, else None.  Pair with :meth:`release`."""
+        now = time.monotonic()
+        rotation = {r.replica_id for r in self.in_rotation()}
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None or replica_id not in rotation:
+                return None
+            if not self._breaker_allows_locked(rep, now):
+                return None
+            rep.outstanding += 1
+            return rep
+
+    def route_ids(self, ids: List) -> Dict[str, List[int]]:
+        """Partition entity ids by their consistent-hash owner among
+        in-rotation replicas: ``{replica_id: [positions...]}`` in input
+        order.  Empty when no replica is available.
+
+        Only the ring FRESHNESS check holds the membership lock; the
+        per-id hashing runs outside it (the ring's node arrays swap
+        atomically on rebuild), so a large id list cannot stall every
+        concurrent dispatch/heartbeat behind SHA-1 work."""
+        eligible = {r.replica_id for r in self.in_rotation()}
+        out: Dict[str, List[int]] = {}
+        if not eligible:
+            return out
+        with self._lock:
+            if self._ring_stale:
+                self._ring.rebuild(sorted(self._replicas))
+                self._ring_stale = False
+            ring = self._ring
+        for i, eid in enumerate(ids):
+            rid = ring.route(str(eid), eligible)
+            if rid is not None:
+                out.setdefault(rid, []).append(i)
+        return out
+
+    def release(self, rep: Replica, ok: bool) -> None:
+        """Report a dispatch outcome: drives load counts AND the
+        breaker state machine."""
+        from xgboost_tpu.obs import event
+        from xgboost_tpu.obs.metrics import fleet_metrics
+        tripped = False
+        with self._lock:
+            rep.outstanding = max(0, rep.outstanding - 1)
+            if rep.breaker == BREAKER_HALF_OPEN:
+                rep.probe_inflight = False
+            if ok:
+                rep.consecutive_failures = 0
+                if rep.breaker != BREAKER_CLOSED:
+                    rep.breaker = BREAKER_CLOSED
+            else:
+                rep.consecutive_failures += 1
+                if rep.breaker == BREAKER_HALF_OPEN:
+                    # failed probe: back to OPEN for another cooldown
+                    rep.breaker = BREAKER_OPEN
+                    rep.breaker_opened_at = time.monotonic()
+                elif (rep.breaker == BREAKER_CLOSED
+                      and rep.consecutive_failures
+                      >= self.breaker_failures):
+                    rep.breaker = BREAKER_OPEN
+                    rep.breaker_opened_at = time.monotonic()
+                    tripped = True
+            state = rep.breaker
+        fm = fleet_metrics()
+        fm.breaker_open.set(rep.replica_id,
+                            0.0 if state == BREAKER_CLOSED else 1.0)
+        if tripped:
+            fm.breaker_trips.inc()
+            event("fleet.breaker_open", replica_id=rep.replica_id,
+                  consecutive_failures=rep.consecutive_failures)
+
+    # ------------------------------------------------------------- health
+    def health_check(self, timeout: float = 2.0) -> None:
+        """One pass over every lease-live replica's ``/healthz``:
+        drain/stopped/unreachable replicas leave rotation, recovered
+        ones rejoin, and the reported model hash is recorded (the
+        rollout controller reads it).  Called from the router's
+        background loop."""
+        now = time.monotonic()
+        with self._lock:
+            targets = [(r.replica_id, r.url)
+                       for r in self._replicas.values()
+                       if r.lease_live(now)]
+        for rid, url in targets:
+            ok, state, mhash = self._probe(url, timeout)
+            with self._lock:
+                rep = self._replicas.get(rid)
+                if rep is None or rep.url != url:
+                    continue  # deregistered/re-registered mid-probe
+                rep.health_ok = ok
+                rep.health_state = state
+                if mhash:
+                    rep.model_hash = mhash
+        from xgboost_tpu.obs.metrics import fleet_metrics
+        fleet_metrics().members.set(len(self.in_rotation()))
+
+    @staticmethod
+    def _probe(url: str, timeout: float):
+        """GET /healthz -> (reachable_and_ok, state, model_hash)."""
+        try:
+            with urllib.request.urlopen(url + "/healthz",
+                                        timeout=timeout) as resp:
+                h = json.loads(resp.read())
+            return True, h.get("state", "serving"), h.get("model_hash")
+        except Exception as e:
+            # unreachable is exactly the signal this probe exists to
+            # turn into "out of rotation"; the reason rides along in
+            # the recorded state for /fleet/members
+            return False, f"unreachable ({type(e).__name__})", None
+
+
+class LeaseClient:
+    """Replica-side registration/heartbeat client (the worker half of
+    the tracker protocol).  Runs a daemon thread that registers with
+    the router, renews the lease at ``lease_sec / 3``, and
+    RE-registers whenever the router forgot us (router restart, lease
+    expiry during a stall) — the ``recover`` path.
+
+    Chaos seams (reliability/faults.py): ``heartbeat_loss`` skips
+    renewals (the lease decays and the router drops us from rotation);
+    ``replica_kill`` fires ``on_kill`` — ``os._exit(43)`` in a real
+    replica process, a server hard-stop in in-process tests."""
+
+    def __init__(self, router_url: str, replica_id: str, self_url: str,
+                 model_path: Optional[str] = None,
+                 model_hash_fn: Optional[Callable[[], Optional[str]]] = None,
+                 on_kill: Optional[Callable[[], None]] = None):
+        self.router_url = router_url.rstrip("/")
+        self.replica_id = replica_id
+        self.self_url = self_url.rstrip("/")
+        self.model_path = model_path
+        self.model_hash_fn = model_hash_fn or (lambda: None)
+        self.on_kill = on_kill or (lambda: os._exit(43))
+        self.lease_sec = 10.0
+        self.registered = False
+        self.heartbeats_sent = 0
+        self.heartbeats_skipped = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ protocol
+    def _post(self, path: str, payload: dict, timeout: float = 3.0) -> dict:
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.router_url + path, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def register(self) -> bool:
+        """One registration attempt; returns success."""
+        try:
+            grant = self._post("/fleet/register", {
+                "replica_id": self.replica_id,
+                "url": self.self_url,
+                "model_path": self.model_path,
+                "model_hash": self.model_hash_fn(),
+                "pid": os.getpid(),
+            })
+            self.lease_sec = float(grant.get("lease_sec", self.lease_sec))
+            self.registered = True
+            return True
+        except Exception as e:
+            # router down/unreachable: stay up and keep retrying — a
+            # replica must serve direct traffic even with no router
+            from xgboost_tpu.obs.metrics import swallowed_error
+            swallowed_error("fleet.lease_client.register", e)
+            self.registered = False
+            return False
+
+    def _heartbeat_once(self) -> None:
+        from xgboost_tpu.reliability import faults
+        faults.check("replica_kill", path=self.replica_id)
+        try:
+            faults.check("heartbeat_loss", path=self.replica_id)
+        except faults.InjectedFault:
+            # chaos: lose this renewal — the lease decays toward expiry
+            self.heartbeats_skipped += 1
+            return
+        try:
+            resp = self._post("/fleet/heartbeat",
+                              {"replica_id": self.replica_id,
+                               "model_hash": self.model_hash_fn()})
+            self.heartbeats_sent += 1
+            if not resp.get("known", True):
+                # the router forgot us (restart / expired lease):
+                # recover by re-registering
+                self.register()
+        except Exception as e:
+            from xgboost_tpu.obs.metrics import swallowed_error
+            swallowed_error("fleet.lease_client.heartbeat", e)
+            self.registered = False
+
+    def deregister(self) -> None:
+        """Announce shutdown (the drain path calls this)."""
+        try:
+            self._post("/fleet/deregister",
+                       {"replica_id": self.replica_id})
+        except Exception as e:
+            from xgboost_tpu.obs.metrics import swallowed_error
+            swallowed_error("fleet.lease_client.deregister", e)
+        self.registered = False
+
+    # ----------------------------------------------------------- lifecycle
+    def _loop(self) -> None:
+        from xgboost_tpu.reliability import faults
+        while not self._stop.wait(max(self.lease_sec / 3.0, 0.05)):
+            try:
+                if not self.registered:
+                    self.register()
+                else:
+                    self._heartbeat_once()
+            except faults.InjectedFault as f:
+                if f.kind == "replica_kill":
+                    # simulated sudden death: no drain, no deregister —
+                    # the router must notice via lease/health alone
+                    self.on_kill()
+                    return
+
+    def start(self) -> "LeaseClient":
+        self.register()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="xgbtpu-fleet-lease")
+        self._thread.start()
+        return self
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        if deregister and self.registered:
+            self.deregister()
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None

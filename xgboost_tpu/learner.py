@@ -1020,6 +1020,11 @@ class Booster:
         set is ONE f32 block, never a full N x F densify."""
         Fm = self.gbtree.cuts.num_feature
         src = getattr(data, "_predict_dense_src", None)
+        if src is None and hasattr(data, "predict_dense_src"):
+            # a lazily-CSR DMatrix built straight from a dense ndarray
+            # (data.py): the caller's buffer is the upload source and
+            # the CSR arrays never materialize for this predict
+            src = data.predict_dense_src()
         if src is not None and src.shape[1] == Fm:
             return lambda s, e: src[s:e]
 
@@ -1158,10 +1163,10 @@ class Booster:
                 # upload the caller's own buffer: the UPLOAD path skips
                 # the CSR→dense densify copy per block and ships views
                 # of arr instead (NaN is the missing marker on both
-                # paths; see _dense_block_fn).  The DMatrix CSR itself
-                # is still built above — predict's cache/info plumbing
-                # and the density gate consume it; making it lazy for
-                # ndarray one-offs is a ROADMAP item
+                # paths; see _dense_block_fn).  The DMatrix above is
+                # CSR-LAZY (data.py): this one-off predict reads only
+                # num_nonmissing() + these views, so the ~2x
+                # values/indices/indptr copy is never built at all
                 data._predict_dense_src = arr
 
         def _counted(out):
@@ -1242,8 +1247,15 @@ class Booster:
             # mostly-NaN ndarray must keep the O(nnz) host-binning
             # path (u8 upload), not ship the full f32 matrix — the
             # direct-buffer view is an UPLOAD optimization for inputs
-            # that are dense anyway, not a routing override
-            dense_enough = (len(data.values)
+            # that are dense anyway, not a routing override.
+            # num_nonmissing() == len(data.values) bit for bit, but a
+            # lazily-CSR dense DMatrix answers it WITHOUT building the
+            # ~2x values/indices/indptr copy this gate alone would
+            # otherwise force (data.py)
+            nnz = (data.num_nonmissing()
+                   if hasattr(data, "num_nonmissing")
+                   else len(data.values))
+            dense_enough = (nnz
                             >= 0.25 * data.num_row * max(data.num_col, 1))
             if self.param.booster == "gblinear":
                 binned = self.gbtree.device_matrix(data)

@@ -597,6 +597,78 @@ def featurestore_metrics() -> FeatureStoreMetrics:
     return _FEATURESTORE
 
 
+# ------------------------------------------------------------------- fleet
+class FleetMetrics:
+    """Router-side fleet accounting (``xgbtpu_fleet_*``, SERVING.md
+    fleet section): per-replica request/error attribution, the global
+    admission budget's shed count, retry and breaker activity, and the
+    membership gauge pair (registered vs in-rotation — their gap is the
+    fleet's sick-replica count).  One instance per process
+    (:func:`fleet_metrics`); rendered into every /metrics body via the
+    registry."""
+
+    def __init__(self, prefix: str = "xgbtpu_fleet"):
+        p = prefix
+        self.requests = LabeledCounter(
+            f"{p}_requests_total", "replica",
+            "requests dispatched by the router, by replica")
+        self.errors = LabeledCounter(
+            f"{p}_errors_total", "replica",
+            "dispatches that failed (connect/5xx), by replica")
+        self.latency = Histogram(
+            f"{p}_latency_seconds",
+            "router-side request latency, dispatch to response "
+            "(includes the replica hop and any retry)")
+        self.shed = Counter(
+            f"{p}_shed_total",
+            "requests shed with 503 by the router's in-flight budget")
+        self.retries = Counter(
+            f"{p}_retries_total",
+            "requests retried on a second replica after a failure")
+        self.breaker_trips = Counter(
+            f"{p}_breaker_trips_total",
+            "circuit breakers tripped open (consecutive failures)")
+        self.breaker_open = LabeledGauge(
+            f"{p}_breaker_open", "replica",
+            "1 while a replica's circuit breaker is open/half-open")
+        self.members = Gauge(
+            f"{p}_members",
+            "replicas currently in rotation (lease live + healthy + "
+            "serving)")
+        self.members_registered = Gauge(
+            f"{p}_members_registered",
+            "replicas currently registered (any state)")
+        self.inflight = Gauge(
+            f"{p}_inflight", "requests in flight through the router")
+        self.rollouts = Counter(
+            f"{p}_rollouts_total", "canary rollouts completed fleet-wide")
+        self.rollbacks = Counter(
+            f"{p}_rollbacks_total",
+            "rollouts rolled back (gate failure or operator command)")
+        self._all = (self.requests, self.errors, self.latency, self.shed,
+                     self.retries, self.breaker_trips, self.breaker_open,
+                     self.members, self.members_registered, self.inflight,
+                     self.rollouts, self.rollbacks)
+        registry().register("fleet", self.render)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._all)
+
+
+_FLEET: Optional[FleetMetrics] = None
+_FLEET_LOCK = threading.Lock()
+
+
+def fleet_metrics() -> FleetMetrics:
+    """The process-wide FleetMetrics singleton."""
+    global _FLEET
+    if _FLEET is None:
+        with _FLEET_LOCK:
+            if _FLEET is None:
+                _FLEET = FleetMetrics()
+    return _FLEET
+
+
 # ----------------------------------------------------------------- serving
 class ServingMetrics:
     """Metric registry for the serving subsystem (see SERVING.md for the

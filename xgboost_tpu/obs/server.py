@@ -26,6 +26,9 @@ PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # header/body go out as two writes: without TCP_NODELAY the body
+    # stalls ~40 ms behind the delayed ACK (same fix as serving/http.py)
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):  # metrics scrapes stay quiet
         pass

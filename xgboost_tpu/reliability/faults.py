@@ -6,16 +6,25 @@ coordinates (``AllreduceMock``, ``subtree/rabit/src/allreduce_mock.h``);
 This module generalizes the idea to every other failure surface the
 system persists or serves through:
 
-========== =============================== ===========================
-kind        effect                          seam
-========== =============================== ===========================
-torn_write  truncate written bytes at N     ``integrity.atomic_write``
-bit_flip    flip one bit at byte N on write ``integrity.atomic_write``
-enospc      raise ``OSError(ENOSPC)``       ``integrity.atomic_write``
-slow_read   sleep N seconds before read     ``integrity.read_file``
-read_flip   flip one bit at byte N on read  ``integrity.read_file``
-reload      raise at the registry reload    ``ModelRegistry`` rebuild
-========== =============================== ===========================
+============== =============================== =========================
+kind            effect                          seam
+============== =============================== =========================
+torn_write      truncate written bytes at N     ``integrity.atomic_write``
+bit_flip        flip one bit at byte N on write ``integrity.atomic_write``
+enospc          raise ``OSError(ENOSPC)``       ``integrity.atomic_write``
+slow_read       sleep N seconds before read     ``integrity.read_file``
+read_flip       flip one bit at byte N on read  ``integrity.read_file``
+reload          raise at the registry reload    ``ModelRegistry`` rebuild
+heartbeat_loss  drop a lease renewal            fleet ``LeaseClient``
+replica_kill    sudden replica death (no drain) fleet ``LeaseClient``
+============== =============================== =========================
+
+The two fleet kinds (``@path`` matches the replica id) prove the
+router's failure paths: ``heartbeat_loss`` lets a lease decay so the
+membership sweep drops the replica from rotation; ``replica_kill``
+fires the lease client's ``on_kill`` — ``os._exit(43)`` in a real
+replica process — without drain or deregistration, exactly the crash
+the health checker + retry-once dispatch must absorb.
 
 Faults are armed with :func:`inject` (tests), the CLI ``faults=``
 parameter, or the ``XGBTPU_FAULTS`` env var (subprocess chaos drivers,
@@ -45,7 +54,7 @@ from typing import List, Optional
 
 _WRITE_KINDS = ("torn_write", "bit_flip", "enospc")
 _READ_KINDS = ("slow_read", "read_flip")
-_POINT_KINDS = ("reload",)
+_POINT_KINDS = ("reload", "heartbeat_loss", "replica_kill")
 _KINDS = _WRITE_KINDS + _READ_KINDS + _POINT_KINDS
 
 

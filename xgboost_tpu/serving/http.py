@@ -106,10 +106,50 @@ def parse_libsvm_rows(text: str, num_feature: int) -> np.ndarray:
     return out
 
 
+def read_request_body(handler, max_bytes: int):
+    """Drain and validate a POST body on a keep-alive connection — THE
+    body-hygiene discipline, shared by the replica handler here and the
+    fleet router's (fleet/router.py).  Under HTTP/1.1 keep-alive,
+    unread body bytes would be parsed as the next request line on the
+    reused connection; bodies we cannot drain deterministically
+    (chunked encoding, bad/negative Content-Length) get an error AND a
+    closed connection, and anything over ``max_bytes`` is refused with
+    413 BEFORE buffering.  Returns the raw bytes, or None when an
+    error response has already been sent (the handler must have
+    ``close_connection``/``_send_json``, i.e. be one of ours)."""
+    te = (handler.headers.get("Transfer-Encoding") or "").lower()
+    if "chunked" in te:
+        handler.close_connection = True
+        handler._send_json(411, {"error": "chunked bodies not "
+                                          "supported; send "
+                                          "Content-Length"})
+        return None
+    try:
+        length = int(handler.headers.get("Content-Length", 0))
+    except ValueError:
+        length = -1
+    if length < 0:
+        handler.close_connection = True
+        handler._send_json(400, {"error": "bad Content-Length"})
+        return None
+    if length > max_bytes:
+        handler.close_connection = True
+        handler._send_json(413, {"error": f"request body {length} "
+                                          f"bytes exceeds limit "
+                                          f"{max_bytes}"})
+        return None
+    return handler.rfile.read(length)
+
+
 class _Handler(BaseHTTPRequestHandler):
     # the server instance carries registry/batcher/metrics (see
     # PredictServer below)
     protocol_version = "HTTP/1.1"
+    # TCP_NODELAY: the response goes out as two writes (header buffer,
+    # then body) — with Nagle on, the body write stalls behind the
+    # peer's delayed ACK of the header segment, a flat ~40 ms added to
+    # EVERY response on an otherwise sub-millisecond predict
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):  # route access logs through quiet
         if not self.server.quiet:
@@ -151,6 +191,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "status": "degraded" if reg.poisoned else "ok",
                 "state": ps.state,
                 "model_version": reg.version,
+                # content hash of what the live engine ACTUALLY serves
+                # (follows rollbacks) — the fleet rollout controller
+                # verifies pushes against it (fleet/rollout.py)
+                "model_hash": reg.content_hash,
                 "uptime_seconds": round(time.perf_counter() - ps.t0, 3),
                 "queue_rows": self.server.batcher.queued_rows,
                 "inflight": ps.inflight,
@@ -176,36 +220,12 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         self._request_id = None  # no leak across keep-alive requests
         url = urlparse(self.path)
-        # ALWAYS drain the body: under HTTP/1.1 keep-alive, unread body
-        # bytes would be parsed as the next request line on the reused
-        # connection (e.g. a POST /-/reload with a JSON body).  Bodies
-        # we cannot drain deterministically (chunked encoding, bad or
-        # negative Content-Length) get an error AND a closed connection
-        # — never a blocking read(-1), never poisoned pipelining.
-        te = (self.headers.get("Transfer-Encoding") or "").lower()
-        if "chunked" in te:
-            self.close_connection = True
-            self._send_json(411, {"error": "chunked bodies not "
-                                           "supported; send Content-Length"})
+        # ALWAYS drain the body (read_request_body: keep-alive hygiene,
+        # 411 chunked / 400 bad length / 413 reject-before-buffering)
+        raw = read_request_body(self, self.server.pserver.max_body_bytes)
+        if raw is None:
             return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-        except ValueError:
-            length = -1
-        if length < 0:
-            self.close_connection = True
-            self._send_json(400, {"error": "bad Content-Length"})
-            return
-        max_body = self.server.pserver.max_body_bytes
-        if length > max_body:
-            # reject-don't-buffer applies to the HTTP layer too: the
-            # bound is enforced BEFORE any body bytes are read, so an
-            # oversized post cannot balloon a handler thread
-            self.close_connection = True
-            self._send_json(413, {"error": f"request body {length} bytes "
-                                           f"exceeds limit {max_body}"})
-            return
-        body = self.rfile.read(length).decode("utf-8", "replace")
+        body = raw.decode("utf-8", "replace")
         if url.path == "/predict":
             self._predict(url, body)
             return
@@ -500,6 +520,9 @@ class PredictServer:
         # store instead of feeding wrong-width rows to the new engine
         self.featurestore = featurestore
         self._fs_lock = threading.Lock()
+        # fleet membership (attach_fleet): registration/heartbeat lease
+        # client against a fleet router; None = standalone replica
+        self.lease_client = None
         self.drain_grace = float(drain_grace)
         self.max_body_bytes = int(max_body_mb * (1 << 20))
         # /healthz uptime_seconds: perf_counter — uptime is a duration,
@@ -556,6 +579,37 @@ class PredictServer:
                 featurestore_metrics().resident_bytes.set(0)
         return store
 
+    # -------------------------------------------------------------- fleet
+    def attach_fleet(self, router_url: str,
+                     replica_id: Optional[str] = None,
+                     advertise_url: str = "",
+                     on_kill=None) -> None:
+        """Join a fleet (SERVING.md fleet section): register with the
+        router at ``router_url`` and keep a heartbeat lease alive.  The
+        lease client starts with :meth:`start`/:meth:`serve_forever`
+        and deregisters when the drain begins, so a draining replica
+        leaves rotation BEFORE it starts 503ing (the router's health
+        checker is the backstop for crashes).  ``replica_id`` defaults
+        to ``host:port`` — a restarted replica re-registering under its
+        old id is the tracker ``recover`` path."""
+        from xgboost_tpu.fleet.membership import LeaseClient
+        rid = replica_id or f"{self.host}:{self.port}"
+        # the ADVERTISED endpoint is what the router dials — a wildcard
+        # bind (0.0.0.0/::) is reachable locally but unroutable from
+        # the router's side, so cross-host replicas must say where they
+        # actually live (serve_advertise_url)
+        self_url = (advertise_url.rstrip("/") if advertise_url
+                    else f"http://{self.host}:{self.port}")
+        if not advertise_url and self.host in ("0.0.0.0", "::", ""):
+            print(f"[fleet] WARNING: advertising wildcard bind "
+                  f"{self_url} to the router — unroutable from other "
+                  "hosts; set serve_advertise_url", file=sys.stderr)
+        self.lease_client = LeaseClient(
+            router_url, rid, self_url,
+            model_path=self.registry.path,
+            model_hash_fn=lambda: self.registry.content_hash,
+            on_kill=on_kill)
+
     # -------------------------------------------------------- drain state
     @property
     def inflight(self) -> int:
@@ -584,6 +638,11 @@ class PredictServer:
         grace = self.drain_grace if grace is None else float(grace)
         t0 = time.perf_counter()
         deadline = t0 + grace
+        if self.lease_client is not None:
+            # leave the fleet FIRST: the router stops dispatching here
+            # before this replica starts answering 503 (requests already
+            # routed ride the retry path)
+            self.lease_client.stop(deregister=True)
         with self._inflight_cv:
             if self.state == "serving":
                 self.state = "draining"
@@ -624,6 +683,8 @@ class PredictServer:
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "PredictServer":
         self.registry.start()
+        if self.lease_client is not None:
+            self.lease_client.start()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="xgbtpu-http")
@@ -632,6 +693,8 @@ class PredictServer:
 
     def serve_forever(self) -> None:
         self.registry.start()
+        if self.lease_client is not None:
+            self.lease_client.start()
         if threading.current_thread() is threading.main_thread():
             try:
                 signal.signal(signal.SIGTERM, self._handle_sigterm)
@@ -650,6 +713,8 @@ class PredictServer:
                 return
             self._shut = True
             self.state = "stopped"
+        if self.lease_client is not None:
+            self.lease_client.stop(deregister=True)
         self.registry.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -666,6 +731,8 @@ def run_server(model_path: str, host: str = "127.0.0.1", port: int = 8080,
                keep_versions: int = 2, warmup: bool = True,
                drain_sec: float = 30.0, max_body_mb: float = 64.0,
                featurestore_mb: float = 0.0,
+               router_url: str = "", replica_id: str = "",
+               advertise_url: str = "",
                quiet: bool = False,
                block: bool = True) -> Optional[PredictServer]:
     """Build the full serving stack for one model file and run it.
@@ -674,6 +741,10 @@ def run_server(model_path: str, host: str = "127.0.0.1", port: int = 8080,
     :class:`~xgboost_tpu.serving.featurestore.FeatureStore` of that
     byte budget, enabling ``POST /predict_by_id`` (zero-upload repeat
     traffic) and the ``/featurestore/*`` admin routes.
+
+    ``router_url`` joins a fleet (xgboost_tpu.fleet): the replica
+    registers with the router there, heartbeats a lease, and
+    deregisters when draining.
 
     With ``block=False`` the server runs on a background thread and the
     :class:`PredictServer` is returned (tests, embedding)."""
@@ -694,6 +765,9 @@ def run_server(model_path: str, host: str = "127.0.0.1", port: int = 8080,
     server = PredictServer(registry, batcher, metrics, host=host, port=port,
                            quiet=quiet, drain_grace=drain_sec,
                            max_body_mb=max_body_mb, featurestore=store)
+    if router_url:
+        server.attach_fleet(router_url, replica_id=replica_id or None,
+                            advertise_url=advertise_url)
     if not quiet:
         eng = registry.engine
         print(f"[serving] model {model_path} (v{registry.version}, "

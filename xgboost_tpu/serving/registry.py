@@ -74,6 +74,11 @@ class ModelRegistry:
         self.engine_kwargs = engine_kwargs
         self.version = 0
         self._engine: Optional[PredictEngine] = None
+        # the content hash of the model the live engine was BUILT from
+        # (not necessarily the on-disk file's — a rollback diverges
+        # them): what /healthz reports and the fleet rollout controller
+        # verifies (fleet/rollout.py)
+        self._hash: Optional[str] = None
         self._previous: deque = deque(maxlen=max(0, self.keep_versions))
         self._fp: Optional[Tuple] = None
         # the failure-path ledger: the fingerprint of content that
@@ -119,6 +124,7 @@ class ModelRegistry:
         engine = self._build_engine(raw)
         with self._swap_lock:
             self._engine, self._fp = engine, fp
+            self._hash = fp[2]
             self.version = 1
         if self.metrics is not None:
             self.metrics.model_version.set(self.version)
@@ -137,9 +143,33 @@ class ModelRegistry:
         need (version, engine) consistent use :meth:`current`."""
         return self._engine
 
+    @property
+    def content_hash(self) -> Optional[str]:
+        """sha256 of the model content the LIVE engine serves.  Follows
+        engine swaps — after a rollback it names the rolled-back-to
+        content, not the newer on-disk file — so a fleet controller
+        (or a human) can verify what each replica actually runs."""
+        return self._hash
+
     def current(self) -> Tuple[int, PredictEngine]:
         with self._swap_lock:
             return self.version, self._engine
+
+    def describe(self) -> dict:
+        """Registry + engine description for operators (the fleet
+        rollout controller reads ``model_hash`` to verify a push)."""
+        with self._swap_lock:
+            d = {"path": self.path,
+                 "model_version": self.version,
+                 "model_hash": self._hash,
+                 "previous_versions": [v for v, _, _ in self._previous],
+                 "poisoned": self._poisoned is not None,
+                 "reload_failures": self.reload_failures,
+                 "last_reload_error": self.last_reload_error,
+                 "build_attempts": self.build_attempts}
+            engine = self._engine
+        d["engine"] = engine.describe()
+        return d
 
     def predict(self, X, output_margin: bool = False):
         """Predict on whatever model is current when the call starts
@@ -187,8 +217,15 @@ class ModelRegistry:
                 raw, fp = self._read_fingerprinted()
             except OSError:
                 return False
-            if self._fp is not None and fp[2] == self._fp[2]:
-                self._fp = fp  # touched but byte-identical: not a reload
+            if self._hash is not None and fp[2] == self._hash:
+                # file content matches what the LIVE ENGINE serves:
+                # not a reload.  Compared against the engine's hash,
+                # NOT the last-loaded fingerprint — after a rollback
+                # the two diverge, and a push of the very bytes the
+                # engine rolled back FROM must load again (the fleet
+                # controller's rollback restores files, then a later
+                # rollout may legitimately re-push the same model)
+                self._fp = fp
                 if self._poisoned is not None:
                     # the file was rolled BACK to the live content (an
                     # operator undoing a bad push): it is no longer
@@ -219,8 +256,10 @@ class ModelRegistry:
                       file=sys.stderr)
                 return False
             with self._swap_lock:
-                self._previous.append((self.version, self._engine))
+                self._previous.append((self.version, self._engine,
+                                       self._hash))
                 self._engine, self._fp = engine, fp
+                self._hash = fp[2]
                 self._poisoned = None
                 self.last_reload_error = None
                 self.version += 1
@@ -250,12 +289,14 @@ class ModelRegistry:
         with self._swap_lock:
             if not self._previous:
                 return False
-            old_version, old_engine = self._previous.pop()
+            old_version, old_engine, old_hash = self._previous.pop()
             # the outgoing engine goes onto the ring in turn, so an
             # accidental rollback is itself reversible (rollback twice
             # toggles between the two newest versions)
-            self._previous.append((self.version, self._engine))
+            self._previous.append((self.version, self._engine,
+                                   self._hash))
             self._engine = old_engine
+            self._hash = old_hash
             # _fp still holds the on-disk fingerprint, so the next
             # poll will NOT re-load the model just rolled back from;
             # the rollback sticks until the file actually changes
