@@ -1,0 +1,692 @@
+"""xgtpu-lint v2: whole-repo contract rules XGT008-XGT011
+(ANALYSIS.md §v2, analysis/contracts.py).
+
+Layers:
+
+1. **fixture mini-trees** — each rule fires on a known-bad tree
+   (unknown endpoint, method mismatch, undocumented/stale metric
+   family, label drift, undocumented/stale knob, unused param key,
+   lock-order cycle) and stays quiet on the good twin;
+2. **inventory** — ANALYSIS_CONTRACTS.json roundtrip, drift detection,
+   and freshness + non-emptiness of the committed file;
+3. **negative tests on REAL facts** — deleting a metric row from a
+   copy of OBSERVABILITY.md / a knob row from a copy of README.md
+   produces a finding against the real package's extraction;
+4. **enforcement** — the tier-1 gate: the whole repo is contract-clean;
+5. **runtime cross-check** — a seeded FeatureStore + Membership stress
+   run under the LockRaceChecker, asserting every lock order the
+   runtime checker observes is an edge of the static XGT011 graph;
+6. **route sweep** — every extracted handler route answers without the
+   no-route 404 body, and unknown paths get the one consistent
+   ``{"error": "no route <path>"}`` JSON 404 on all three servers.
+
+The mini-tree and inventory layers are pure stdlib-AST work; the
+stress and route layers run tiny CPU jax programs — no mesh/AxisType
+gating needed.
+"""
+
+import json
+import os
+import random
+import shutil
+import threading
+
+import pytest
+
+from xgboost_tpu.analysis.__main__ import main as lint_main
+from xgboost_tpu.analysis.contracts import (CONTRACT_CODES,
+                                            ContractEngine,
+                                            default_engine)
+
+PKG_DIR = os.path.dirname(os.path.abspath(__import__(
+    "xgboost_tpu").__file__))
+REPO_ROOT = os.path.dirname(PKG_DIR)
+TOOLS_DIR = os.path.join(REPO_ROOT, "tools")
+
+
+def engine_for(tmp_path, codes=None, fact_paths=None) -> ContractEngine:
+    return ContractEngine(str(tmp_path), fact_paths=fact_paths,
+                          codes=codes)
+
+
+def run_codes(tmp_path, codes=None):
+    act, sup = engine_for(tmp_path, codes=codes).run()
+    return act, sup
+
+
+def messages(findings):
+    return "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------------ XGT008
+SERVER_SRC = """\
+from http.server import BaseHTTPRequestHandler
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path == "/healthz":
+            return
+        if self.path in ("/metrics", "/status"):
+            return
+    def do_POST(self):
+        if self.path == "/predict":
+            return
+"""
+
+
+class TestHTTPContractParity:
+    def test_unknown_endpoint_fires(self, tmp_path):
+        (tmp_path / "server.py").write_text(SERVER_SRC)
+        (tmp_path / "client.py").write_text(
+            'import http.client\n'
+            'def go(conn):\n'
+            '    conn.request("POST", "/predicz", body=b"")\n')
+        act, _ = run_codes(tmp_path, {"XGT008"})
+        assert [f.rule for f in act] == ["XGT008"]
+        assert "/predicz" in act[0].message
+        assert act[0].path.endswith("client.py")
+
+    def test_method_mismatch_fires(self, tmp_path):
+        (tmp_path / "server.py").write_text(SERVER_SRC)
+        (tmp_path / "client.py").write_text(
+            'def go(conn):\n'
+            '    conn.request("GET", "/predict")\n')
+        act, _ = run_codes(tmp_path, {"XGT008"})
+        assert len(act) == 1 and "method mismatch" in act[0].message
+
+    def test_matching_pair_is_clean(self, tmp_path):
+        (tmp_path / "server.py").write_text(SERVER_SRC)
+        (tmp_path / "client.py").write_text(
+            'import urllib.request\n'
+            'def go(conn, url, post):\n'
+            '    conn.request("POST", "/predict", body=b"")\n'
+            '    urllib.request.urlopen(url + "/healthz")\n'
+            '    post("/predict")  # _post-style helpers are POST\n'
+            'def _post(path):\n'
+            '    pass\n'
+            'def fwd(rep, call):\n'
+            '    call(rep, "GET", "/status", b"", {})\n')
+        act, _ = run_codes(tmp_path, {"XGT008"})
+        assert not act, messages(act)
+
+    def test_inline_suppression_silences(self, tmp_path):
+        (tmp_path / "server.py").write_text(SERVER_SRC)
+        (tmp_path / "client.py").write_text(
+            'def go(conn):\n'
+            '    conn.request("POST", "/elsewhere")'
+            '  # xgtpu: disable=XGT008\n')
+        act, sup = run_codes(tmp_path, {"XGT008"})
+        assert not act and len(sup) == 1
+
+    def test_no_handlers_means_no_client_findings(self, tmp_path):
+        # a tree with clients but no route tables (another service's
+        # client library) has nothing to hold the calls against
+        (tmp_path / "client.py").write_text(
+            'def go(conn):\n'
+            '    conn.request("POST", "/whatever")\n')
+        act, _ = run_codes(tmp_path, {"XGT008"})
+        assert not act
+
+
+# ------------------------------------------------------------------ XGT009
+DOC_HEADER = "| family | type |\n|---|---|\n"
+
+
+class TestMetricFamilyDrift:
+    def test_undocumented_family_fires(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            'c = Counter("xgbtpu_foo_total", "h")\n')
+        (tmp_path / "OBSERVABILITY.md").write_text(DOC_HEADER)
+        act, _ = run_codes(tmp_path, {"XGT009"})
+        assert len(act) == 1 and "xgbtpu_foo_total" in act[0].message
+        assert act[0].path.endswith("m.py")
+
+    def test_stale_doc_row_fires_at_doc_line(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            'c = Counter("xgbtpu_foo_total", "h")\n')
+        (tmp_path / "OBSERVABILITY.md").write_text(
+            DOC_HEADER + "| `xgbtpu_foo_total` | counter |\n"
+                         "| `xgbtpu_gone_total` | counter |\n")
+        act, _ = run_codes(tmp_path, {"XGT009"})
+        assert len(act) == 1 and "xgbtpu_gone_total" in act[0].message
+        assert act[0].path.endswith("OBSERVABILITY.md")
+        assert act[0].line == 4
+
+    def test_brace_expansion_and_fstring_resolution(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            'OPS = ("hits", "misses")\n'
+            'class G:\n'
+            '    def __init__(self, prefix="xgbtpu_store"):\n'
+            '        p = prefix\n'
+            '        for op in OPS:\n'
+            '            Counter(f"{p}_{op}_total", "h")\n')
+        (tmp_path / "OBSERVABILITY.md").write_text(
+            DOC_HEADER + "| `xgbtpu_store_{hits,misses}_total` | c |\n")
+        act, _ = run_codes(tmp_path, {"XGT009"})
+        assert not act, messages(act)
+
+    def test_label_drift_vs_doc_fires(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            'c = LabeledCounter("xgbtpu_l_total", "site", "h")\n')
+        (tmp_path / "OBSERVABILITY.md").write_text(
+            DOC_HEADER + "| `xgbtpu_l_total{kind=}` | counter |\n")
+        act, _ = run_codes(tmp_path, {"XGT009"})
+        assert len(act) == 1 and "label drift" in act[0].message
+
+    def test_inconsistent_labels_across_sites_fire(self, tmp_path):
+        (tmp_path / "a.py").write_text(
+            'c = LabeledCounter("xgbtpu_l_total", "site", "h")\n')
+        (tmp_path / "b.py").write_text(
+            'c = LabeledCounter("xgbtpu_l_total", "kind", "h")\n')
+        act, _ = run_codes(tmp_path, {"XGT009"})
+        assert any("INCONSISTENT" in f.message for f in act)
+
+    def test_mixed_labeled_unlabeled_family_does_not_crash_inventory(
+            self, tmp_path):
+        # review r8: sorting family tuples compared a None label
+        # against a str — the inventory must survive the exact input
+        # the INCONSISTENT-labels finding exists to report
+        (tmp_path / "m.py").write_text(
+            'c = Counter("xgbtpu_x_total", "h")\n'
+            'd = LabeledCounter("xgbtpu_x_total", "op", "h")\n')
+        eng = engine_for(tmp_path, codes={"XGT009"})
+        inv = eng.inventory()
+        assert "xgbtpu_x_total" in inv["metric_families"]
+        act, _ = eng.run()
+        assert any("INCONSISTENT" in f.message for f in act)
+
+    def test_no_doc_file_skips_doc_checks(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            'c = Counter("xgbtpu_foo_total", "h")\n')
+        act, _ = run_codes(tmp_path, {"XGT009"})
+        assert not act
+
+    def test_removing_real_doc_row_is_detected(self, tmp_path):
+        """THE acceptance negative: drop one family from a copy of the
+        real OBSERVABILITY.md and lint the real package against it."""
+        text = open(os.path.join(REPO_ROOT, "OBSERVABILITY.md")).read()
+        doctored = "\n".join(
+            line for line in text.splitlines()
+            if "xgbtpu_fleet_shed_total" not in line)
+        assert doctored != text
+        (tmp_path / "OBSERVABILITY.md").write_text(doctored)
+        eng = engine_for(tmp_path, codes={"XGT009"},
+                         fact_paths=[PKG_DIR])
+        act, _ = eng.run()
+        assert any("xgbtpu_fleet_shed_total" in f.message
+                   and f.rule == "XGT009" for f in act), messages(act)
+
+
+# ------------------------------------------------------------------ XGT010
+class TestKnobDrift:
+    def test_undocumented_knob_fires(self, tmp_path):
+        (tmp_path / "k.py").write_text(
+            'import os\nv = os.environ.get("XGBTPU_FIX_KNOB")\n')
+        (tmp_path / "README.md").write_text("nothing here\n")
+        act, _ = run_codes(tmp_path, {"XGT010"})
+        assert len(act) == 1 and "XGBTPU_FIX_KNOB" in act[0].message
+        assert act[0].path.endswith("k.py")
+
+    def test_documented_knob_is_clean_and_module_const_resolves(
+            self, tmp_path):
+        (tmp_path / "k.py").write_text(
+            'import os\n'
+            'KNOB = "XGBTPU_FIX_KNOB"\n'
+            'v = os.environ.get(KNOB)\n')
+        (tmp_path / "README.md").write_text(
+            "| `XGBTPU_FIX_KNOB` | `0` | a knob |\n")
+        act, _ = run_codes(tmp_path, {"XGT010"})
+        assert not act, messages(act)
+
+    def test_stale_readme_knob_fires_at_doc_line(self, tmp_path):
+        (tmp_path / "k.py").write_text(
+            'import os\nv = os.environ.get("XGBTPU_FIX_KNOB")\n')
+        (tmp_path / "README.md").write_text(
+            "| `XGBTPU_FIX_KNOB` | live |\n"
+            "| `XGBTPU_GONE_KNOB` | stale |\n")
+        act, _ = run_codes(tmp_path, {"XGT010"})
+        assert len(act) == 1 and "XGBTPU_GONE_KNOB" in act[0].message
+        assert act[0].path.endswith("README.md") and act[0].line == 2
+
+    def test_unused_param_table_key_fires(self, tmp_path):
+        (tmp_path / "config.py").write_text(
+            'SERVE_PARAMS = {"serve_x": (1, "help")}\n')
+        act, _ = run_codes(tmp_path, {"XGT010"})
+        assert len(act) == 1 and "'serve_x'" in act[0].message
+        (tmp_path / "cli.py").write_text('v = sp["serve_x"]\n')
+        act, _ = run_codes(tmp_path, {"XGT010"})
+        assert not act
+
+    def test_removing_real_readme_knob_row_is_detected(self, tmp_path):
+        """Acceptance negative #2: drop a knob row from a copy of the
+        real README and lint the real package against it."""
+        text = open(os.path.join(REPO_ROOT, "README.md")).read()
+        doctored = "\n".join(
+            line for line in text.splitlines()
+            if "XGBTPU_HIST_RTILE" not in line)
+        assert doctored != text
+        (tmp_path / "README.md").write_text(doctored)
+        eng = engine_for(tmp_path, codes={"XGT010"},
+                         fact_paths=[PKG_DIR])
+        act, _ = eng.run()
+        assert any("XGBTPU_HIST_RTILE" in f.message
+                   and f.rule == "XGT010" for f in act), messages(act)
+
+
+# ------------------------------------------------------------------ XGT011
+def lock_tree(order_m2: str) -> str:
+    return (
+        'import threading\n'
+        'class A:\n'
+        '    def __init__(self):\n'
+        '        self._lock_a = threading.Lock()\n'
+        '        self._lock_b = threading.Lock()\n'
+        '    def m1(self):\n'
+        '        with self._lock_a:\n'
+        '            with self._lock_b:\n'
+        '                pass\n'
+        '    def m2(self):\n' + order_m2)
+
+
+class TestLockOrderGraph:
+    CONSISTENT = ('        with self._lock_a:\n'
+                  '            with self._lock_b:\n'
+                  '                pass\n')
+    INVERTED = ('        with self._lock_b:\n'
+                '            with self._lock_a:\n'
+                '                pass\n')
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        (tmp_path / "locks.py").write_text(lock_tree(self.CONSISTENT))
+        act, _ = run_codes(tmp_path, {"XGT011"})
+        assert not act, messages(act)
+
+    def test_reordered_pair_fires(self, tmp_path):
+        """Acceptance negative #3: reordering one nested lock pair
+        produces a cycle finding."""
+        (tmp_path / "locks.py").write_text(lock_tree(self.INVERTED))
+        act, _ = run_codes(tmp_path, {"XGT011"})
+        assert len(act) == 1 and "lock-order cycle" in act[0].message
+        assert "A._lock_a" in act[0].message
+        assert "A._lock_b" in act[0].message
+
+    def test_multi_item_with_orders_left_to_right(self, tmp_path):
+        src = ('import threading\n'
+               'class B:\n'
+               '    def one(self):\n'
+               '        with self._l1, self._l2_lock:\n'
+               '            pass\n'
+               '    def two(self):\n'
+               '        with self._l2_lock:\n'
+               '            with self._l1_lock:\n'
+               '                pass\n')
+        # _l1 is not lock-named -> only the _l2_lock edge family exists,
+        # and no cycle forms
+        (tmp_path / "locks.py").write_text(src)
+        act, _ = run_codes(tmp_path, {"XGT011"})
+        assert not act
+        src2 = ('import threading\n'
+                'class C:\n'
+                '    def one(self):\n'
+                '        with self._l1_lock, self._l2_lock:\n'
+                '            pass\n'
+                '    def two(self):\n'
+                '        with self._l2_lock:\n'
+                '            with self._l1_lock:\n'
+                '                pass\n')
+        (tmp_path / "locks.py").write_text(src2)
+        act, _ = run_codes(tmp_path, {"XGT011"})
+        assert len(act) == 1 and "lock-order cycle" in act[0].message
+
+    def test_three_node_cycle_reports_without_crashing(self, tmp_path):
+        # review r8: the anchor must come from REAL edges — a cycle
+        # whose direction disagrees with sorted node order used to hit
+        # min() over an empty sequence exactly when a deadlock existed
+        src = ('import threading\n'
+               'class A:\n'
+               '    def m1(self):\n'
+               '        with self._a_lock:\n'
+               '            with self._c_lock:\n'
+               '                pass\n'
+               '    def m2(self):\n'
+               '        with self._c_lock:\n'
+               '            with self._b_lock:\n'
+               '                pass\n'
+               '    def m3(self):\n'
+               '        with self._b_lock:\n'
+               '            with self._a_lock:\n'
+               '                pass\n')
+        (tmp_path / "locks.py").write_text(src)
+        act, _ = run_codes(tmp_path, {"XGT011"})
+        assert len(act) == 1 and "lock-order cycle" in act[0].message
+        assert "A._a_lock -> A._c_lock" in act[0].message
+        assert act[0].path.endswith("locks.py") and act[0].line > 0
+
+    def test_repo_graph_has_edges_and_no_cycles(self):
+        eng = ContractEngine(REPO_ROOT, fact_paths=[PKG_DIR],
+                             codes={"XGT011"})
+        facts = eng.facts()
+        edges = {(o, i) for _, o, i, _ in facts.lock_edges}
+        # the two-lock classes the ISSUE names must be in the graph
+        assert ("FeatureStore._put_lock", "FeatureStore._lock") in edges
+        act, _ = eng.run()
+        assert not [f for f in act if f.rule == "XGT011"], messages(act)
+
+
+# ----------------------------------------------------------- inventory
+class TestInventory:
+    def _mini_tree(self, tmp_path):
+        (tmp_path / "server.py").write_text(SERVER_SRC)
+        (tmp_path / "m.py").write_text(
+            'import os, threading\n'
+            'c = Counter("xgbtpu_foo_total", "h")\n'
+            'v = os.environ.get("XGBTPU_FIX_KNOB")\n'
+            'class A:\n'
+            '    def m(self):\n'
+            '        with self._a_lock:\n'
+            '            with self._b_lock:\n'
+            '                pass\n')
+        (tmp_path / "OBSERVABILITY.md").write_text(
+            DOC_HEADER + "| `xgbtpu_foo_total` | counter |\n")
+        (tmp_path / "README.md").write_text("| `XGBTPU_FIX_KNOB` | x |\n")
+
+    def test_roundtrip(self, tmp_path):
+        self._mini_tree(tmp_path)
+        eng = engine_for(tmp_path)
+        out = eng.write_inventory()
+        with open(out) as f:
+            committed = json.load(f)
+        assert committed == eng.inventory()
+        assert committed["http_routes"]
+        assert committed["metric_families"] == {
+            "xgbtpu_foo_total": {"label": None}}
+        assert committed["env_knobs"] == ["XGBTPU_FIX_KNOB"]
+        assert committed["lock_edges"] == [["A._a_lock", "A._b_lock"]]
+        # a fresh engine over the same tree sees no drift
+        act, _ = engine_for(tmp_path).run()
+        assert not act, messages(act)
+
+    @pytest.mark.parametrize("section,rule", [
+        ("http_routes", "XGT008"), ("metric_families", "XGT009"),
+        ("env_knobs", "XGT010"), ("lock_edges", "XGT011")])
+    def test_drift_detection_per_section(self, tmp_path, section, rule):
+        self._mini_tree(tmp_path)
+        eng = engine_for(tmp_path)
+        path = eng.write_inventory()
+        with open(path) as f:
+            data = json.load(f)
+        data[section] = []
+        with open(path, "w") as f:
+            json.dump(data, f)
+        act, _ = engine_for(tmp_path).run()
+        hits = [f for f in act if f.rule == rule
+                and section in f.message]
+        assert hits, messages(act)
+        assert hits[0].path.endswith("ANALYSIS_CONTRACTS.json")
+
+    def test_committed_inventory_is_fresh_and_nonempty(self):
+        """Acceptance: ANALYSIS_CONTRACTS.json is committed, matches
+        the tree's extraction, and every inventory is non-empty."""
+        path = os.path.join(REPO_ROOT, "ANALYSIS_CONTRACTS.json")
+        assert os.path.exists(path), "ANALYSIS_CONTRACTS.json missing"
+        with open(path) as f:
+            committed = json.load(f)
+        eng = default_engine([PKG_DIR])
+        assert committed == eng.inventory(), (
+            "committed ANALYSIS_CONTRACTS.json is stale — regenerate "
+            "with tools/xgtpu_lint.py --write-contracts")
+        assert committed["http_routes"]
+        assert committed["metric_families"]
+        assert committed["env_knobs"]
+        assert committed["lock_edges"]
+        assert committed["cli_params"]["serve"]
+        assert committed["cli_params"]["fleet"]
+
+
+# ---------------------------------------------------------- enforcement
+def test_contract_tree_is_clean():
+    """THE tier-1 gate for XGT008-XGT011: the whole repo (package +
+    tools + docs + committed inventory) is contract-clean."""
+    eng = default_engine([PKG_DIR])
+    act, _ = eng.run()
+    assert not act, (
+        f"xgtpu-lint contracts found {len(act)} violation(s):\n"
+        + messages(act))
+
+
+def test_cli_default_invocation_runs_contracts_clean(capsys):
+    assert lint_main(["--rules", ",".join(CONTRACT_CODES)]) == 0
+
+
+def test_cli_changed_mode_works():
+    # facts collect repo-wide; a clean tree stays clean however the
+    # reporting is narrowed
+    assert lint_main(["--changed", "HEAD"]) == 0
+
+
+def test_cli_changed_refuses_write_baseline(tmp_path, capsys):
+    # review r8: a narrowed-reporting scan must not rewrite the ledger
+    assert lint_main(["--changed", "HEAD", "--write-baseline",
+                      "--baseline", str(tmp_path / "b.json")]) == 2
+
+
+def test_changed_mode_reports_doc_anchored_drift_from_py_edit(capsys):
+    """Review r8: drift CAUSED by a changed .py file anchors in the
+    unchanged doc/inventory surfaces — the pre-commit loop must not
+    drop those findings or it passes on exactly the drift the change
+    introduced.  An untracked package file constructing an
+    undocumented family must surface BOTH the code-anchored XGT009
+    finding and the inventory-staleness finding anchored at the
+    (unchanged) ANALYSIS_CONTRACTS.json."""
+    probe = os.path.join(PKG_DIR, "_contract_probe_tmp.py")
+    try:
+        with open(probe, "w") as f:
+            f.write('c = Counter("xgbtpu_probe_only_total", "h")\n')
+        rc = lint_main(["--changed", "HEAD"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "xgbtpu_probe_only_total" in out
+        assert "ANALYSIS_CONTRACTS.json" in out
+    finally:
+        os.remove(probe)
+
+
+def test_write_baseline_subset_scan_keeps_per_file_debt_elsewhere(
+        tmp_path):
+    """Review r8: the contract engine's repo-wide fact scope must NOT
+    leak into the per-file rescope coverage — a subdirectory
+    --write-baseline has to keep accepted per-file debt outside the
+    scanned subset (while contract entries DO rescope repo-wide)."""
+    from xgboost_tpu.analysis import Baseline
+    bpath = str(tmp_path / "b.json")
+    Baseline({
+        "XGT006|xgboost_tpu/learner.py|fake = time.time() - t0": 1,
+        "XGT010|README.md|`XGBTPU_GONE` stale row": 1,
+    }).dump(bpath)
+    assert lint_main([os.path.join(PKG_DIR, "serving"),
+                      "--write-baseline", "--baseline", bpath]) == 0
+    merged = Baseline.load(bpath)
+    # per-file debt outside the scanned subset survives...
+    assert any(k.startswith("XGT006|") for k in merged.counts), (
+        merged.counts)
+    # ...while the stale contract entry was re-scoped away (contract
+    # findings re-collect repo-wide and this one no longer exists)
+    assert not any(k.startswith("XGT010|") for k in merged.counts), (
+        merged.counts)
+
+
+# ------------------------------------------------- runtime cross-check
+def _static_lock_edges():
+    eng = ContractEngine(REPO_ROOT, fact_paths=[PKG_DIR])
+    return {(o, i) for _, o, i, _ in eng.facts().lock_edges}
+
+
+def _parse_instrumented(name: str):
+    """'FeatureStore#1._put_lock' -> ('FeatureStore', '_put_lock')."""
+    cls, _, attr = name.partition("#")
+    return cls, attr.split(".", 1)[1]
+
+
+def test_runtime_lock_orders_covered_by_static_graph(lock_race_checker):
+    """The ISSUE's cross-check: stress FeatureStore (two-lock
+    put/gather staging) and Membership under the runtime
+    LockRaceChecker — no violations — and every same-class lock ORDER
+    the runtime checker observes must be an edge of the static XGT011
+    graph (the static rule subsumes what the dynamic one happened to
+    see)."""
+    from xgboost_tpu.fleet.membership import Membership
+    from xgboost_tpu.serving.featurestore import FeatureStore
+    import numpy as np
+
+    store = FeatureStore(num_feature=4, budget_mb=0.0001)  # ~6 slots
+    lock_race_checker.instrument(
+        store, locks=("_lock", "_put_lock"),
+        guarded=("_slots", "_free", "_slab"))
+    m = Membership(lease_sec=30.0)
+    lock_race_checker.instrument(m, locks=("_lock",),
+                                 guarded=("_ring_stale",))
+
+    def worker(seed: int):
+        rng = random.Random(seed)
+        for i in range(30):
+            op = rng.randrange(6)
+            ids = [f"e{rng.randrange(10)}" for _ in range(2)]
+            if op == 0:
+                store.put(ids, np.full((2, 4), float(i), np.float32))
+            elif op == 1:
+                X, missing = store.gather(ids, pad_to=4)
+                assert (X is None) == bool(missing)
+            elif op == 2:
+                store.invalidate(ids if rng.random() < 0.5 else None)
+            elif op == 3:
+                rid = f"r{rng.randrange(3)}"
+                m.register(rid, f"http://127.0.0.1:{9000 + seed}")
+                m.heartbeat(rid)
+            elif op == 4:
+                rep = m.acquire()
+                if rep is not None:
+                    m.release(rep, ok=bool(rng.randrange(2)))
+            else:
+                m.route_ids(ids)
+                m.deregister(f"r{rng.randrange(3)}")
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    static_edges = _static_lock_edges()
+    runtime_same_class = set()
+    for a, b in lock_race_checker._edges:
+        ca, aa = _parse_instrumented(a)
+        cb, ab = _parse_instrumented(b)
+        if ca == cb and (ca, aa) != (cb, ab):
+            runtime_same_class.add((f"{ca}.{aa}", f"{cb}.{ab}"))
+    # the put path's _put_lock -> _lock staging MUST have been seen
+    assert ("FeatureStore._put_lock", "FeatureStore._lock") \
+        in runtime_same_class
+    uncovered = runtime_same_class - static_edges
+    assert not uncovered, (
+        f"runtime observed lock orders missing from the static XGT011 "
+        f"graph: {sorted(uncovered)}")
+    # teardown runs lock_race_checker.assert_clean()
+
+
+# ------------------------------------------------------- route sweep
+def _handler_routes():
+    """(server_key, method, path) for every route the extractor found
+    in the three handler files — the parametrized 404-sweep surface."""
+    eng = ContractEngine(REPO_ROOT, fact_paths=[
+        os.path.join(PKG_DIR, "serving"),
+        os.path.join(PKG_DIR, "fleet"),
+        os.path.join(PKG_DIR, "obs")])
+    keymap = {"xgboost_tpu/serving/http.py": "serving",
+              "xgboost_tpu/fleet/router.py": "router",
+              "xgboost_tpu/obs/server.py": "obs"}
+    out = set()
+    for f, _cls, method, path, _ in eng.facts().routes:
+        rel = os.path.relpath(f, REPO_ROOT).replace(os.sep, "/")
+        key = keymap.get(rel)
+        if key:
+            out.add((key, method, path))
+    assert len(out) >= 20, sorted(out)
+    return sorted(out)
+
+
+ROUTES = _handler_routes()
+
+
+@pytest.fixture(scope="module")
+def servers():
+    """One live instance of each HTTP tier: replica server (tiny
+    model), fleet router (no replicas), obs metrics server."""
+    import numpy as np
+    import xgboost_tpu as xgb
+    from xgboost_tpu.fleet.router import FleetRouter
+    from xgboost_tpu.obs.server import MetricsServer
+    from xgboost_tpu.serving.http import run_server
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="xgbtpu_routes_")
+    rng = np.random.RandomState(0)
+    X = rng.rand(80, 4).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 2,
+                     "eta": 0.4, "silent": 1},
+                    xgb.DMatrix(X, label=y), 2)
+    path = os.path.join(work, "m.bin")
+    bst.save_model(path)
+    srv = run_server(path, port=0, min_bucket=8, max_bucket=16,
+                     max_wait_ms=1, poll_sec=0, warmup=False,
+                     quiet=True, block=False)
+    router = FleetRouter(port=0, hc_sec=0, quiet=True).start()
+    mets = MetricsServer(port=0)
+    try:
+        yield {"serving": ("127.0.0.1", srv.port),
+               "router": ("127.0.0.1", router.port),
+               "obs": ("127.0.0.1", mets.port)}
+    finally:
+        srv.shutdown()
+        router.shutdown()
+        mets.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def _req(addr, method, path):
+    import http.client
+    conn = http.client.HTTPConnection(*addr, timeout=10)
+    try:
+        body = b"" if method == "POST" else None
+        conn.request(method, path, body=body)
+        r = conn.getresponse()
+        raw = r.read()
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            payload = {}
+        return r.status, payload
+    finally:
+        conn.close()
+
+
+@pytest.mark.parametrize("key,method,path", ROUTES)
+def test_extracted_routes_are_served(servers, key, method, path):
+    """Every route the static extractor found answers as a KNOWN
+    route: whatever the status (200/400/404-disabled/409/503), the
+    body is never the no-route 404 — the extractor and the dispatch
+    are the same table."""
+    status, payload = _req(servers[key], method, path)
+    err = payload.get("error", "") if isinstance(payload, dict) else ""
+    assert not err.startswith("no route"), (method, path, status, err)
+
+
+def test_unknown_routes_404_with_consistent_json_body(servers):
+    """The round-8 sweep contract: all three servers reject unknown
+    paths — both methods — with the SAME JSON 404 body shape."""
+    for key, addr in servers.items():
+        for method in ("GET", "POST"):
+            status, payload = _req(addr, method, "/definitely/not/here")
+            assert status == 404, (key, method, status)
+            assert payload.get("error") == \
+                "no route /definitely/not/here", (key, method, payload)

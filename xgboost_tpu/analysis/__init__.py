@@ -9,6 +9,14 @@ collectives under rank-dependent control flow (XGT007).  Run it with
 ``python -m xgboost_tpu.analysis`` or ``tools/xgtpu_lint.py``; tier-1
 enforces a clean tree via ``tests/test_analysis.py``.
 
+Cross-file half (:mod:`~xgboost_tpu.analysis.contracts`): a two-phase
+engine — per-file fact collectors feeding whole-repo checkers — for the
+contracts that drift *between* files: HTTP route/client parity
+(XGT008), metric-family drift against OBSERVABILITY.md (XGT009), env
+knob + CLI param-table drift (XGT010), and the static lock-order graph
+(XGT011).  The extracted inventories are committed as
+``ANALYSIS_CONTRACTS.json`` so contract changes land as reviewed diffs.
+
 Dynamic half (:mod:`~xgboost_tpu.analysis.runtime`): the
 ``RecompileGuard`` (XLA backend-compile counting, the generalized
 serving zero-steady-state-compile assertion) and the
@@ -17,10 +25,14 @@ writes without the lock and lock-order inversions), both exposed as
 pytest fixtures in ``tests/conftest.py``.
 """
 
+from xgboost_tpu.analysis.contracts import (CONTRACT_CODES,  # noqa: F401
+                                            ContractEngine,
+                                            default_engine)
 from xgboost_tpu.analysis.core import (Baseline, Finding,  # noqa: F401
                                        Result, analyze_source,
                                        default_baseline_path, run)
 from xgboost_tpu.analysis.rules import all_rules, rules_by_code  # noqa: F401
 
 __all__ = ["Baseline", "Finding", "Result", "analyze_source", "run",
-           "default_baseline_path", "all_rules", "rules_by_code"]
+           "default_baseline_path", "all_rules", "rules_by_code",
+           "CONTRACT_CODES", "ContractEngine", "default_engine"]

@@ -6,6 +6,14 @@ Exit-code contract (what CI keys off):
   1  findings
   2  usage / internal error
 
+Cross-file contract rules (XGT008-XGT011, analysis/contracts.py) run
+alongside the per-file rules by default: facts are collected from the
+whole repo (package + ``tools/``) regardless of which subset of paths
+was scanned, because a contract is only checkable whole.  ``--changed
+[REF]`` narrows REPORTING to files touched vs. a git ref (the fast
+pre-commit loop); ``--write-contracts`` regenerates the committed
+``ANALYSIS_CONTRACTS.json`` inventory.
+
 ``tools/xgtpu_lint.py`` is a thin wrapper around this module.
 """
 
@@ -14,15 +22,46 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from xgboost_tpu.analysis import core
+from xgboost_tpu.analysis.contracts import (CONTRACT_CODES,
+                                            CONTRACT_RULE_DOCS,
+                                            default_engine, repo_root)
 from xgboost_tpu.analysis.rules import all_rules, rules_by_code
 
 
 def _default_paths() -> List[str]:
     return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def _split_rule_codes(spec: str):
+    """-> (per-file rule list, contract code set).  Raises ValueError
+    on unknown codes (matching rules_by_code's contract)."""
+    wanted = {c.strip().upper() for c in spec.split(",") if c.strip()}
+    contract = {c for c in wanted if c in CONTRACT_CODES}
+    per_file_codes = wanted - contract
+    per_file = rules_by_code(per_file_codes) if per_file_codes else []
+    return per_file, contract
+
+
+def _changed_files(ref: str) -> Set[str]:
+    """Absolute paths of files changed vs. ``ref`` (diff + untracked).
+    Raises CalledProcessError when git/ref is unusable."""
+    root = repo_root()
+    out: Set[str] = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", ref, "--"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             check=True)
+        for line in res.stdout.splitlines():
+            line = line.strip()
+            if line:
+                out.add(os.path.abspath(os.path.join(root, line)))
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -47,6 +86,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept every current finding into the "
                          "baseline file and exit 0")
+    ap.add_argument("--no-contracts", action="store_true",
+                    help="skip the cross-file contract rules "
+                         "(XGT008-XGT011)")
+    ap.add_argument("--write-contracts", action="store_true",
+                    help="regenerate ANALYSIS_CONTRACTS.json from the "
+                         "extracted route/metric/knob/lock inventories "
+                         "and exit")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="report only findings anchored in files "
+                         "changed vs. REF (default HEAD); cross-file "
+                         "facts still collect repo-wide")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print baselined findings")
     try:
@@ -54,17 +105,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     except SystemExit as e:
         return 0 if e.code in (0, None) else 2
 
+    contract_codes = set(CONTRACT_CODES)
     try:
-        rules = (rules_by_code(args.rules.split(","))
-                 if args.rules else all_rules())
+        if args.rules:
+            rules, contract_codes = _split_rule_codes(args.rules)
+        else:
+            rules = all_rules()
     except ValueError as e:
         print(f"xgtpu-lint: {e}", file=sys.stderr)
         return 2
+    if args.no_contracts:
+        contract_codes = set()
 
     if args.list_rules:
         for r in rules:
             doc = (r.__class__.__doc__ or "").strip().splitlines()[0]
             print(f"{r.code}  {r.name:<28s} {doc}")
+        for code in sorted(contract_codes):
+            name, doc = CONTRACT_RULE_DOCS[code]
+            print(f"{code}  {name:<28s} {doc} [cross-file]")
         return 0
 
     paths = args.paths or _default_paths()
@@ -72,6 +131,58 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not os.path.exists(p):
             print(f"xgtpu-lint: no such path: {p}", file=sys.stderr)
             return 2
+
+    engine = (default_engine(paths, codes=contract_codes)
+              if contract_codes else None)
+
+    if args.write_contracts:
+        if engine is None:
+            print("xgtpu-lint: --write-contracts needs the contract "
+                  "rules enabled", file=sys.stderr)
+            return 2
+        out = engine.write_inventory()
+        inv = engine.inventory()
+        print(f"xgtpu-lint: wrote {out} "
+              f"({len(inv['http_routes'])} routes, "
+              f"{len(inv['metric_families'])} metric families, "
+              f"{len(inv['env_knobs'])} env knobs, "
+              f"{len(inv['lock_edges'])} lock edges)", file=sys.stderr)
+        return 0
+
+    anchor_filter = None
+    if args.changed is not None:
+        if args.write_baseline:
+            print("xgtpu-lint: --write-baseline cannot be combined "
+                  "with --changed (a narrowed-reporting scan must not "
+                  "rewrite the accepted-debt ledger)", file=sys.stderr)
+            return 2
+        try:
+            changed = _changed_files(args.changed)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            print(f"xgtpu-lint: --changed failed: {detail.strip()}",
+                  file=sys.stderr)
+            return 2
+        # per-file rules only parse the changed .py files under the
+        # scanned scope; contract facts still collect repo-wide and
+        # the anchor filter narrows what gets REPORTED.  Contract
+        # findings anchored in the doc/inventory surfaces always pass
+        # the filter: drift CAUSED by a changed .py file anchors there
+        # (a stale OBSERVABILITY.md row, a stale ANALYSIS_CONTRACTS
+        # section), and dropping those would make the pre-commit loop
+        # pass on exactly the cross-file drift the change introduced
+        scope = [os.path.abspath(p) for p in paths]
+        paths = sorted(
+            f for f in changed
+            if f.endswith(".py") and os.path.exists(f)
+            and any(f == s or f.startswith(s.rstrip(os.sep) + os.sep)
+                    for s in scope))
+        doc_anchors = (set(engine.doc_surfaces())
+                       if engine is not None else set())
+        anchor_filter = (
+            lambda f: os.path.abspath(f.path) in changed
+            or (f.rule in CONTRACT_CODES
+                and os.path.abspath(f.path) in doc_anchors))
 
     baseline_path = args.baseline or core.default_baseline_path()
     baseline = None
@@ -88,7 +199,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
 
-    result = core.run(paths, baseline=baseline, rules=rules)
+    result = core.run(paths, baseline=baseline, rules=rules,
+                      contracts=engine, anchor_filter=anchor_filter)
 
     if args.write_baseline:
         if args.rules:
@@ -107,7 +219,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"xgtpu-lint: bad baseline {baseline_path}: {e}",
                   file=sys.stderr)
             return 2
-        merged = old.rescoped(result.findings, paths)
+        # rescope PER RULE CLASS (baseline keys lead with the rule
+        # code, so the two ledgers partition cleanly): per-file
+        # findings were only re-collected from the scanned paths —
+        # entries elsewhere must survive a subdirectory scan — while
+        # contract findings were re-collected from the engine's
+        # repo-wide fact scope + doc/inventory surfaces, and THAT is
+        # their coverage; one rule-blind union either erases per-file
+        # debt outside the scanned subset or keeps-and-re-adds contract
+        # findings anchored outside it, inflating counts every run
+        contract = set(CONTRACT_CODES)
+
+        def split_counts(b):
+            return (core.Baseline({k: v for k, v in b.counts.items()
+                                   if k.split("|", 1)[0] not in contract}),
+                    core.Baseline({k: v for k, v in b.counts.items()
+                                   if k.split("|", 1)[0] in contract}))
+
+        old_pf, old_ct = split_counts(old)
+        pf = [f for f in result.findings if f.rule not in contract]
+        ct = [f for f in result.findings if f.rule in contract]
+        merged = old_pf.rescoped(pf, paths)
+        ct_cov = (list(engine.fact_paths) + engine.doc_surfaces()
+                  if engine is not None else [])
+        merged.counts.update(old_ct.rescoped(ct, ct_cov).counts)
         merged.dump(baseline_path)
         print(f"xgtpu-lint: accepted {len(result.findings)} finding(s) "
               f"for the scanned paths ({sum(merged.counts.values())} "

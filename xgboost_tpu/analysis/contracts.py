@@ -1,0 +1,901 @@
+"""xgtpu-lint v2: whole-repo contract analysis (ANALYSIS.md §v2).
+
+The PR-4 rules are single-file AST passes; the surfaces PRs 5-7 grew
+drift *between* files: three stdlib HTTP servers spoken to by a
+half-dozen hand-rolled clients, dozens of ``xgbtpu_*`` metric families
+documented by hand in OBSERVABILITY.md, ``XGBTPU_*`` env knobs mirrored
+into README tables, and 20+ lock acquisition sites guarded only by the
+*runtime* LockRaceChecker.  This module turns those conventions into
+enforced cross-file invariants with a two-phase engine:
+
+1. **fact collection** — one AST pass per file extracts route tables
+   (``do_GET``/``do_POST`` path dispatch), HTTP client calls, metric
+   family constructions (names resolved through f-strings, prefix
+   defaults and constant loops), ``XGBTPU_*`` env reads, the
+   ``SERVE_PARAMS``/``FLEET_PARAMS`` tables, and nested
+   ``with self.<lock>`` acquisition pairs;
+2. **whole-repo checking** — the collected facts are judged against
+   each other and against the committed docs:
+
+   - **XGT008** HTTP contract parity: every client call targets a route
+     some handler defines, with the right method;
+   - **XGT009** metric-family drift: every constructed ``xgbtpu_*``
+     family appears in OBSERVABILITY.md's inventory table and vice
+     versa, with consistent label sets;
+   - **XGT010** knob drift: every ``XGBTPU_*`` env read is documented
+     in README.md, every documented knob is read somewhere, and every
+     ``SERVE_PARAMS``/``FLEET_PARAMS`` key is consumed outside its
+     table (the "one table, two surfaces" discipline, mechanized);
+   - **XGT011** static lock-order graph: nested lock acquisitions
+     keyed by ``(class, lock attr)`` form a global digraph that must be
+     acyclic — the static complement of the runtime LockRaceChecker,
+     which only sees orders a test happens to execute.
+
+The extracted inventories are committed as ``ANALYSIS_CONTRACTS.json``
+(:meth:`ContractEngine.inventory`) so reviewers see contract diffs in
+PRs; a stale committed inventory is itself a finding (regenerate with
+``--write-contracts``).
+
+Findings ride the PR-4 machinery unchanged: inline
+``# xgtpu: disable=XGT00x`` suppressions work at the anchored line of
+``.py``-anchored findings, baseline keys are content-addressed, and the
+CLI/exit contract is shared (``python -m xgboost_tpu.analysis``).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from xgboost_tpu.analysis.core import (FileContext, Finding, Suppressions,
+                                       const_str, default_baseline_path,
+                                       iter_py_files, terminal_name)
+
+#: the cross-file rule codes this engine owns
+CONTRACT_CODES = ("XGT008", "XGT009", "XGT010", "XGT011")
+
+#: one-line catalog entries (``--list-rules``)
+CONTRACT_RULE_DOCS = {
+    "XGT008": ("http-contract-parity",
+               "HTTP client calls must match a handler route table "
+               "entry (endpoint + method)"),
+    "XGT009": ("metric-family-drift",
+               "xgbtpu_* families in code <-> OBSERVABILITY.md "
+               "inventory, labels consistent"),
+    "XGT010": ("knob-drift",
+               "XGBTPU_* env reads <-> README knob docs; "
+               "SERVE_PARAMS/FLEET_PARAMS keys consumed"),
+    "XGT011": ("lock-order-cycle",
+               "global nested-lock acquisition graph must be acyclic"),
+}
+
+_HTTP_METHODS = frozenset({"GET", "POST", "PUT", "DELETE", "HEAD",
+                           "PATCH"})
+_FAMILY_RE = re.compile(r"^xgbtpu_[a-z0-9_]+$")
+_KNOB_RE = re.compile(r"XGBTPU_[A-Z0-9_]+")
+_METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram",
+                           "LabeledCounter", "LabeledGauge",
+                           "counter", "gauge", "histogram"})
+_LABELED_CTORS = frozenset({"LabeledCounter", "LabeledGauge"})
+
+#: doc files, looked up at the engine root
+OBSERVABILITY_DOC = "OBSERVABILITY.md"
+README_DOC = "README.md"
+CONTRACTS_FILE = "ANALYSIS_CONTRACTS.json"
+
+
+def _lockish(attr: str) -> bool:
+    """The lock-attribute heuristic shared with XGT005, widened to the
+    condition-variable and mutex spellings this tree uses."""
+    a = attr.lower()
+    return "lock" in a or a.endswith("_cv") or a == "_mu"
+
+
+# ------------------------------------------------------------------ facts
+class Facts:
+    """Everything phase 1 extracted, across every scanned file."""
+
+    def __init__(self):
+        # (file, handler_class, method, path, line)
+        self.routes: List[Tuple[str, str, str, str, int]] = []
+        # (file, method, path, line)
+        self.clients: List[Tuple[str, str, str, int]] = []
+        # (file, family, label_or_None, line)
+        self.families: List[Tuple[str, str, Optional[str], int]] = []
+        # (file, knob, line)
+        self.knobs: List[Tuple[str, str, int]] = []
+        # (file, table 'serve'|'fleet', key, line)
+        self.params: List[Tuple[str, str, str, int]] = []
+        # (file, outer 'Class.attr', inner 'Class.attr', line)
+        self.lock_edges: List[Tuple[str, str, str, int]] = []
+        # file -> every string constant in it (param-consumption check)
+        self.str_consts: Dict[str, Set[str]] = {}
+        # file -> Suppressions (inline disables apply to contract
+        # findings anchored there, same as the per-file rules)
+        self.suppressions: Dict[str, Suppressions] = {}
+        # file -> source lines (snippet lookups re-use the phase-1
+        # read instead of reopening the file)
+        self.lines: Dict[str, List[str]] = {}
+        self.files: List[str] = []
+
+
+# --------------------------------------------------------------- resolver
+class _FileResolver:
+    """Best-effort constant resolution for strings: literals,
+    f-strings over parameter defaults / simple local assignments /
+    module constants, and loop variables ranging over constant string
+    tuples (``for op in OPS:``).  Returns the LIST of possible values,
+    or None when the expression is not statically resolvable —
+    precision over recall, like every rule here."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.module_consts: Dict[str, str] = {}
+        self.module_seqs: Dict[str, Tuple[str, ...]] = {}
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            s = const_str(node.value)
+            if s is not None:
+                self.module_consts[name] = s
+            elif isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = [const_str(e) for e in node.value.elts]
+                if vals and all(v is not None for v in vals):
+                    self.module_seqs[name] = tuple(vals)
+
+    def resolve(self, node: ast.AST,
+                seen: frozenset = frozenset()) -> Optional[List[str]]:
+        s = const_str(node)
+        if s is not None:
+            return [s]
+        if isinstance(node, ast.JoinedStr):
+            outs = [""]
+            for part in node.values:
+                if isinstance(part, ast.Constant):
+                    vals = [str(part.value)]
+                elif isinstance(part, ast.FormattedValue):
+                    r = self.resolve(part.value, seen)
+                    if r is None:
+                        return None
+                    vals = r
+                else:
+                    return None
+                outs = [o + v for o in outs for v in vals]
+            return outs
+        if isinstance(node, ast.Name):
+            return self._resolve_name(node, seen)
+        return None
+
+    def _resolve_name(self, node: ast.Name,
+                      seen: frozenset) -> Optional[List[str]]:
+        name = node.id
+        if name in seen:
+            return None
+        seen = seen | {name}
+        func = None
+        for anc in self.ctx.ancestors(node):
+            if isinstance(anc, ast.For) and func is None:
+                tgt = anc.target
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return self._resolve_iter(anc.iter, seen)
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if func is None:
+                    func = anc
+        if func is not None:
+            for sub in ast.walk(func):
+                if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)
+                        and sub.targets[0].id == name):
+                    r = self.resolve(sub.value, seen)
+                    if r is not None:
+                        return r
+            d = self._param_default(func, name)
+            if d is not None:
+                return [d]
+        if name in self.module_consts:
+            return [self.module_consts[name]]
+        if name in self.module_seqs:
+            return list(self.module_seqs[name])
+        return None
+
+    def _resolve_iter(self, it: ast.AST,
+                      seen: frozenset) -> Optional[List[str]]:
+        if isinstance(it, (ast.Tuple, ast.List)):
+            vals = [const_str(e) for e in it.elts]
+            if vals and all(v is not None for v in vals):
+                return vals
+            return None
+        if isinstance(it, ast.Name):
+            return list(self.module_seqs.get(it.id, ())) or None
+        return None
+
+    @staticmethod
+    def _param_default(fn, name: str) -> Optional[str]:
+        pos = fn.args.posonlyargs + fn.args.args
+        defaults = fn.args.defaults
+        for i, a in enumerate(pos):
+            if a.arg != name:
+                continue
+            j = i - (len(pos) - len(defaults))
+            if 0 <= j < len(defaults):
+                return const_str(defaults[j])
+            return None
+        for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if a.arg == name and d is not None:
+                return const_str(d)
+        return None
+
+
+# ------------------------------------------------------------- collectors
+def _with_lock_attrs(node: ast.With) -> List[str]:
+    """Lock attrs entered by one ``with``, in item order (the
+    XGT005 helper widened by :func:`_lockish`)."""
+    out = []
+    for item in node.items:
+        e = item.context_expr
+        if (isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == "self" and _lockish(e.attr)):
+            out.append(e.attr)
+    return out
+
+
+def _norm_path(p: str) -> str:
+    return p.split("?", 1)[0]
+
+
+def collect_file(ctx: FileContext, facts: Facts) -> None:
+    """Phase 1 for one parsed file: extract every fact the phase-2
+    checkers consume."""
+    res = _FileResolver(ctx)
+    facts.files.append(ctx.path)
+    facts.suppressions[ctx.path] = Suppressions(ctx.source)
+    facts.lines[ctx.path] = ctx.lines
+    consts = facts.str_consts.setdefault(ctx.path, set())
+    seen_clients: Set[Tuple[str, str, int]] = set()
+
+    def add_client(method: str, path: str, line: int) -> None:
+        path = _norm_path(path)
+        if not path.startswith("/"):
+            return
+        key = (method, path, line)
+        if key not in seen_clients:
+            seen_clients.add(key)
+            facts.clients.append((ctx.path, method, path, line))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            consts.add(node.value)
+        if isinstance(node, ast.ClassDef):
+            _collect_routes(ctx, node, facts)
+            _collect_lock_edges(ctx, node, facts)
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            _collect_param_table(ctx, node, facts)
+        if isinstance(node, ast.Subscript):
+            _collect_env_subscript(ctx, node, res, facts)
+        if not isinstance(node, ast.Call):
+            continue
+        _collect_metric_ctor(ctx, node, res, facts)
+        _collect_env_call(ctx, node, res, facts)
+        _collect_client_call(node, add_client)
+
+
+def _collect_routes(ctx: FileContext, cls: ast.ClassDef,
+                    facts: Facts) -> None:
+    """Route tables from ``do_GET``/``do_POST`` path dispatch: every
+    comparison of something against a ``"/"``-leading string constant
+    inside those methods is a route this handler serves."""
+    for fn in cls.body:
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if fn.name not in ("do_GET", "do_POST"):
+            continue
+        method = fn.name[3:]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not all(isinstance(op, (ast.Eq, ast.In))
+                       for op in node.ops):
+                continue
+            for comp in node.comparators:
+                elts = (comp.elts if isinstance(comp, (ast.Tuple, ast.List))
+                        else [comp])
+                for e in elts:
+                    s = const_str(e)
+                    if s and s.startswith("/"):
+                        facts.routes.append(
+                            (ctx.path, cls.name, method, s, node.lineno))
+
+
+def _collect_lock_edges(ctx: FileContext, cls: ast.ClassDef,
+                        facts: Facts) -> None:
+    """Nested ``with self.<lock>`` acquisition pairs, keyed
+    ``Class.attr``: multi-item ``with a, b:`` orders a before b, and a
+    ``with`` lexically inside another (same function) orders outer
+    before inner.  Cross-function nesting (a method called with a lock
+    held) is the runtime checker's domain."""
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.With):
+            continue
+        attrs = _with_lock_attrs(node)
+        if not attrs:
+            continue
+        for i, a in enumerate(attrs):
+            for b in attrs[i + 1:]:
+                facts.lock_edges.append(
+                    (ctx.path, f"{cls.name}.{a}", f"{cls.name}.{b}",
+                     node.lineno))
+        outer_attrs: List[str] = []
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                break
+            if isinstance(anc, ast.With):
+                outer_attrs.extend(_with_lock_attrs(anc))
+        for outer in outer_attrs:
+            for inner in attrs:
+                facts.lock_edges.append(
+                    (ctx.path, f"{cls.name}.{outer}",
+                     f"{cls.name}.{inner}", node.lineno))
+
+
+def _collect_param_table(ctx: FileContext, node, facts: Facts) -> None:
+    if isinstance(node, ast.Assign):
+        if (len(node.targets) != 1
+                or not isinstance(node.targets[0], ast.Name)):
+            return
+        name = node.targets[0].id
+    elif isinstance(node, ast.AnnAssign):  # SERVE_PARAMS: Dict[...] = {..}
+        if not isinstance(node.target, ast.Name):
+            return
+        name = node.target.id
+    else:
+        return
+    table = {"SERVE_PARAMS": "serve", "FLEET_PARAMS": "fleet"}.get(name)
+    if table is None or not isinstance(node.value, ast.Dict):
+        return
+    for k in node.value.keys:
+        s = const_str(k) if k is not None else None
+        if s:
+            facts.params.append((ctx.path, table, s, k.lineno))
+
+
+def _collect_metric_ctor(ctx: FileContext, node: ast.Call,
+                         res: _FileResolver, facts: Facts) -> None:
+    fname = terminal_name(node.func)
+    if fname not in _METRIC_CTORS or not node.args:
+        return
+    names = res.resolve(node.args[0])
+    if not names:
+        return
+    label: Optional[str] = None
+    if fname in _LABELED_CTORS and len(node.args) >= 2:
+        lab = res.resolve(node.args[1])
+        if lab and len(lab) == 1:
+            label = lab[0]
+    for fam in names:
+        if _FAMILY_RE.match(fam):
+            facts.families.append((ctx.path, fam, label, node.lineno))
+
+
+def _is_environ(node: ast.AST) -> bool:
+    return ((isinstance(node, ast.Attribute) and node.attr == "environ")
+            or (isinstance(node, ast.Name) and node.id == "environ"))
+
+
+def _collect_env_call(ctx: FileContext, node: ast.Call,
+                      res: _FileResolver, facts: Facts) -> None:
+    fname = terminal_name(node.func)
+    if fname in ("get", "setdefault"):
+        if not (isinstance(node.func, ast.Attribute)
+                and _is_environ(node.func.value)):
+            return
+    elif fname != "getenv":
+        return
+    if not node.args:
+        return
+    for knob in (res.resolve(node.args[0]) or ()):
+        if _KNOB_RE.fullmatch(knob) and knob != "XGBTPU_":
+            facts.knobs.append((ctx.path, knob, node.lineno))
+
+
+def _collect_env_subscript(ctx: FileContext, node: ast.Subscript,
+                           res: _FileResolver, facts: Facts) -> None:
+    if not (_is_environ(node.value)
+            and isinstance(node.ctx, ast.Load)):
+        return
+    for knob in (res.resolve(node.slice) or ()):
+        if _KNOB_RE.fullmatch(knob) and knob != "XGBTPU_":
+            facts.knobs.append((ctx.path, knob, node.lineno))
+
+
+def _collect_client_call(node: ast.Call, add_client) -> None:
+    """HTTP client call extraction — every hand-rolled client shape in
+    this tree:
+
+    - ``conn.request("POST", "/predict", ...)``
+    - ``urlopen(url + "/healthz")`` (GET)
+    - ``self._post("/fleet/register", payload)`` (POST by convention)
+    - adjacent constants ``("GET", "/metrics")`` anywhere in a call's
+      positionals (the rollout controller's ``_call``/``forward``
+      plumbing)
+    """
+    fname = terminal_name(node.func)
+    if fname == "request" and len(node.args) >= 2:
+        m, p = const_str(node.args[0]), const_str(node.args[1])
+        if m in _HTTP_METHODS and p and p.startswith("/"):
+            add_client(m, p, node.lineno)
+            return
+    if fname == "urlopen" and node.args:
+        arg0 = node.args[0]
+        if (isinstance(arg0, ast.BinOp) and isinstance(arg0.op, ast.Add)):
+            p = const_str(arg0.right)
+            if p and p.startswith("/"):
+                add_client("GET", p, node.lineno)
+                return
+    if fname == "_post" and node.args:
+        p = const_str(node.args[0])
+        if p and p.startswith("/"):
+            add_client("POST", p, node.lineno)
+            return
+    args = node.args
+    for i in range(len(args) - 1):
+        m, p = const_str(args[i]), const_str(args[i + 1])
+        if m in _HTTP_METHODS and p and p.startswith("/"):
+            add_client(m, p, node.lineno)
+            return
+
+
+# ------------------------------------------------------------ doc parsing
+def _doc_metric_table(text: str) -> Dict[str, Tuple[Optional[str], int]]:
+    """Parse OBSERVABILITY.md's metric inventory: backticked tokens in
+    the first cell of table rows.  ``{a,b}`` groups expand to
+    alternatives; a trailing ``{label=}`` names the family's single
+    label dimension.  Tokens not matching the family grammar (prose,
+    shorthand) are ignored — which is the forcing function toward
+    explicit full names."""
+    out: Dict[str, Tuple[Optional[str], int]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.lstrip().startswith("|"):
+            continue
+        first_cell = line.lstrip().lstrip("|").split("|", 1)[0]
+        for tok in re.findall(r"`([^`]+)`", first_cell):
+            for fam, label in _expand_doc_token(tok.strip()):
+                out.setdefault(fam, (label, lineno))
+    return out
+
+
+def _expand_doc_token(tok: str) -> List[Tuple[str, Optional[str]]]:
+    label = None
+    m = re.search(r"\{([a-z_]+)=\}$", tok)
+    if m:
+        label = m.group(1)
+        tok = tok[:m.start()]
+    names = [tok]
+    while True:
+        expanded: List[str] = []
+        changed = False
+        for n in names:
+            m = re.search(r"\{([^{}=]+)\}", n)
+            if m and "," in m.group(1):
+                changed = True
+                for alt in m.group(1).split(","):
+                    expanded.append(n[:m.start()] + alt.strip()
+                                    + n[m.end():])
+            else:
+                expanded.append(n)
+        names = expanded
+        if not changed:
+            break
+    return [(n, label) for n in names if _FAMILY_RE.match(n)]
+
+
+def _doc_knobs(text: str) -> Dict[str, int]:
+    """Every backticked ``XGBTPU_*`` token in README, with its first
+    line.  Table rows and prose both count as documentation — the
+    contract is that the name is findable at all."""
+    out: Dict[str, int] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        for span_text in re.findall(r"`([^`]+)`", line):
+            for knob in _KNOB_RE.findall(span_text):
+                if knob != "XGBTPU_":
+                    out.setdefault(knob, lineno)
+    return out
+
+
+# ---------------------------------------------------------------- engine
+class ContractEngine:
+    """Phase-1 + phase-2 driver for one tree.
+
+    ``root`` is where the docs (OBSERVABILITY.md, README.md) and the
+    committed inventory (ANALYSIS_CONTRACTS.json) are looked up;
+    ``fact_paths`` are the directories/files facts are collected from.
+    For the real repo use :func:`default_engine`, which pins the fact
+    scope to the package + ``tools/`` regardless of what subset the CLI
+    was pointed at — contracts are whole-repo by nature.
+    """
+
+    def __init__(self, root: str,
+                 fact_paths: Optional[Sequence[str]] = None,
+                 codes: Optional[Iterable[str]] = None):
+        self.root = os.path.abspath(root)
+        if fact_paths is None:
+            fact_paths = [self.root]
+        self.fact_paths = [os.path.abspath(p) for p in fact_paths]
+        self.codes = set(codes if codes is not None else CONTRACT_CODES)
+        self._facts: Optional[Facts] = None
+
+    # ----------------------------------------------------------- phase 1
+    def facts(self) -> Facts:
+        if self._facts is not None:
+            return self._facts
+        facts = Facts()
+        for path in iter_py_files(self.fact_paths):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError):
+                continue  # per-file rules already report XGT000 there
+            collect_file(FileContext(path, source, tree), facts)
+        self._facts = facts
+        return facts
+
+    def _doc(self, name: str) -> Tuple[Optional[str], str]:
+        path = os.path.join(self.root, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                return f.read(), path
+        except OSError:
+            return None, path
+
+    def _rel(self, path: str) -> str:
+        try:
+            rel = os.path.relpath(os.path.abspath(path), self.root)
+        except ValueError:
+            rel = path
+        return rel.replace(os.sep, "/")
+
+    # ----------------------------------------------------------- phase 2
+    def run(self) -> Tuple[List[Finding], List[Finding]]:
+        """-> (active findings, suppressed findings)."""
+        facts = self.facts()
+        findings: List[Finding] = []
+        if "XGT008" in self.codes:
+            findings += self._check_routes(facts)
+        if "XGT009" in self.codes:
+            findings += self._check_metrics(facts)
+        if "XGT010" in self.codes:
+            findings += self._check_knobs(facts)
+        if "XGT011" in self.codes:
+            findings += self._check_locks(facts)
+        findings += self._check_inventory_drift(facts)
+        active: List[Finding] = []
+        suppressed: List[Finding] = []
+        for f in findings:
+            sup = facts.suppressions.get(f.path)
+            (suppressed if sup is not None and sup.is_suppressed(f)
+             else active).append(f)
+        active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return active, suppressed
+
+    def _finding(self, rule: str, path: str, line: int, message: str,
+                 snippet: str = "") -> Finding:
+        if not snippet:
+            lines = (self._facts.lines.get(path)
+                     if self._facts is not None else None)
+            if lines is None:
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        lines = f.read().splitlines()
+                except OSError:
+                    lines = []
+            if 1 <= line <= len(lines):
+                snippet = lines[line - 1]
+        return Finding(rule=rule, path=path, line=line, col=0,
+                       message=message, snippet=snippet)
+
+    # ------------------------------------------------------------ XGT008
+    def _check_routes(self, facts: Facts) -> List[Finding]:
+        if not facts.routes:
+            return []  # no handlers in scope: nothing to hold clients to
+        table: Dict[str, Set[str]] = {}
+        for _, _, method, path, _ in facts.routes:
+            table.setdefault(path, set()).add(method)
+        out = []
+        for file, method, path, line in facts.clients:
+            methods = table.get(path)
+            if methods is None:
+                out.append(self._finding(
+                    "XGT008", file, line,
+                    f"HTTP client calls {method} {path}, but no handler "
+                    "route table (do_GET/do_POST dispatch) defines that "
+                    "endpoint — typo, or the route was removed without "
+                    "its callers"))
+            elif method not in methods:
+                out.append(self._finding(
+                    "XGT008", file, line,
+                    f"HTTP method mismatch: client sends {method} "
+                    f"{path}, handlers serve it only via "
+                    f"{'/'.join(sorted(methods))}"))
+        return out
+
+    # ------------------------------------------------------------ XGT009
+    def _check_metrics(self, facts: Facts) -> List[Finding]:
+        out: List[Finding] = []
+        by_family: Dict[str, List[Tuple[str, Optional[str], int]]] = {}
+        for file, fam, label, line in facts.families:
+            by_family.setdefault(fam, []).append((file, label, line))
+        for fam, sites in sorted(by_family.items()):
+            labels = {lab for _, lab, _ in sites}
+            if len(labels) > 1:
+                file, _, line = sites[-1]
+                out.append(self._finding(
+                    "XGT009", file, line,
+                    f"metric family {fam} is constructed with "
+                    "INCONSISTENT label sets across sites "
+                    f"({sorted(str(x) for x in labels)}) — scrapers see "
+                    "one family, it must have one label schema"))
+        doc_text, doc_path = self._doc(OBSERVABILITY_DOC)
+        if doc_text is None or not facts.families:
+            return out
+        documented = _doc_metric_table(doc_text)
+        for fam, sites in sorted(by_family.items()):
+            file, label, line = sites[0]
+            if fam not in documented:
+                out.append(self._finding(
+                    "XGT009", file, line,
+                    f"metric family {fam} is constructed here but "
+                    f"missing from {OBSERVABILITY_DOC}'s metric "
+                    "inventory table — add a row (full family name in "
+                    "backticks)"))
+                continue
+            doc_label, _ = documented[fam]
+            if doc_label != label:
+                out.append(self._finding(
+                    "XGT009", file, line,
+                    f"label drift on {fam}: code constructs label "
+                    f"{label!r}, {OBSERVABILITY_DOC} documents "
+                    f"{doc_label!r}"))
+        for fam, (label, lineno) in sorted(documented.items()):
+            if fam not in by_family:
+                out.append(self._finding(
+                    "XGT009", doc_path, lineno,
+                    f"{OBSERVABILITY_DOC} documents metric family "
+                    f"{fam}, which no code constructs — stale row or "
+                    "renamed family"))
+        return out
+
+    # ------------------------------------------------------------ XGT010
+    def _check_knobs(self, facts: Facts) -> List[Finding]:
+        out: List[Finding] = []
+        readme, readme_path = self._doc(README_DOC)
+        reads: Dict[str, Tuple[str, int]] = {}
+        for file, knob, line in facts.knobs:
+            reads.setdefault(knob, (file, line))
+        if readme is not None and facts.knobs:
+            documented = _doc_knobs(readme)
+            for knob, (file, line) in sorted(reads.items()):
+                if knob not in documented:
+                    out.append(self._finding(
+                        "XGT010", file, line,
+                        f"env knob {knob} is read here but undocumented "
+                        f"in {README_DOC} — add it to the knob table"))
+            for knob, lineno in sorted(documented.items()):
+                if knob not in reads:
+                    out.append(self._finding(
+                        "XGT010", readme_path, lineno,
+                        f"{README_DOC} documents env knob {knob}, which "
+                        "nothing reads — stale doc or renamed knob"))
+        # every SERVE_PARAMS/FLEET_PARAMS key must be consumed somewhere
+        # outside its defining table (the CLI surface references each
+        # key explicitly: sp["serve_x"] / fp["fleet_x"])
+        for file, table, key, line in facts.params:
+            used = any(key in consts
+                       for path, consts in facts.str_consts.items()
+                       if path != file)
+            if not used:
+                out.append(self._finding(
+                    "XGT010", file, line,
+                    f"{table.upper()}_PARAMS key {key!r} is never "
+                    "referenced outside its table — the knob is "
+                    "documented but not wired to any surface"))
+        return out
+
+    # ------------------------------------------------------------ XGT011
+    def _check_locks(self, facts: Facts) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for file, outer, inner, line in facts.lock_edges:
+            graph.setdefault(outer, set()).add(inner)
+            graph.setdefault(inner, set())
+            sites.setdefault((outer, inner), (file, line))
+        out = []
+        for cycle in _find_cycles(graph):
+            # anchor on a REAL edge inside the cycle's node set — the
+            # sorted node list is a set, not a walk, so zipping it
+            # would fabricate edges the graph does not have
+            members = set(cycle)
+            real = sorted((a, b) for (a, b) in sites
+                          if a in members and b in members
+                          and b in graph.get(a, ()))
+            anchor = min(sites[e] for e in real) if real else ("", 0)
+            edge_s = ", ".join(f"{a} -> {b}" for a, b in real)
+            out.append(self._finding(
+                "XGT011", anchor[0], anchor[1],
+                f"lock-order cycle among {{{', '.join(cycle)}}} "
+                f"(acquisition edges: {edge_s}) — two call paths "
+                "acquiring these locks concurrently can deadlock; "
+                "pick one global order (the runtime LockRaceChecker "
+                "only sees orders a test executes; this graph sees "
+                "them all)"))
+        return out
+
+    # -------------------------------------------------------- inventory
+    def inventory(self) -> dict:
+        """The committed-contract view of the extracted facts: stable,
+        line-number-free, repo-root-relative — the thing reviewers diff
+        in PRs (ANALYSIS_CONTRACTS.json)."""
+        facts = self.facts()
+        routes = sorted({(self._rel(f), cls, m, p)
+                         for f, cls, m, p, _ in facts.routes})
+        families: Dict[str, Optional[str]] = {}
+        # sort on hashable columns only: a family constructed both with
+        # and without a label (itself an XGT009 finding) must not crash
+        # the inventory on a None-vs-str comparison
+        for _, fam, label, _ in sorted(
+                facts.families, key=lambda t: (t[0], t[1], t[3])):
+            families.setdefault(fam, label)
+        params: Dict[str, List[str]] = {"serve": [], "fleet": []}
+        for _, table, key, _ in facts.params:
+            if key not in params[table]:
+                params[table].append(key)
+        edges = sorted({(o, i) for _, o, i, _ in facts.lock_edges})
+        return {
+            "version": 1,
+            "http_routes": [
+                {"file": f, "handler": cls, "method": m, "path": p}
+                for f, cls, m, p in routes],
+            "metric_families": {
+                fam: {"label": families[fam]}
+                for fam in sorted(families)},
+            "env_knobs": sorted({k for _, k, _ in facts.knobs}),
+            "cli_params": {t: sorted(ks) for t, ks in params.items()},
+            "lock_edges": [list(e) for e in edges],
+        }
+
+    def contracts_path(self) -> str:
+        return os.path.join(self.root, CONTRACTS_FILE)
+
+    def doc_surfaces(self) -> List[str]:
+        """Absolute paths of the doc/inventory files contract findings
+        may anchor in (existing files only) — the CLI's ``--changed``
+        filter and ``--write-baseline`` coverage both key off this, so
+        a new checked doc surface automatically rides along."""
+        out = []
+        for name in (OBSERVABILITY_DOC, README_DOC, CONTRACTS_FILE):
+            p = os.path.join(self.root, name)
+            if os.path.exists(p):
+                out.append(p)
+        return out
+
+    def write_inventory(self, path: Optional[str] = None) -> str:
+        path = path or self.contracts_path()
+        payload = (json.dumps(self.inventory(), indent=2,
+                              sort_keys=False) + "\n").encode()
+        from xgboost_tpu.reliability.integrity import atomic_write
+        atomic_write(path, payload, durable=False)
+        return path
+
+    _SECTION_RULE = {"http_routes": "XGT008",
+                     "metric_families": "XGT009",
+                     "env_knobs": "XGT010",
+                     "cli_params": "XGT010",
+                     "lock_edges": "XGT011"}
+
+    def _check_inventory_drift(self, facts: Facts) -> List[Finding]:
+        """The committed ANALYSIS_CONTRACTS.json must match what the
+        tree extracts NOW — a contract change lands as a reviewed diff
+        of the inventory, never silently."""
+        path = self.contracts_path()
+        if not os.path.exists(path):
+            return []
+        try:
+            with open(path, encoding="utf-8") as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as e:
+            return [self._finding(
+                "XGT008", path, 1,
+                f"{CONTRACTS_FILE} is unreadable ({e}) — regenerate "
+                "with --write-contracts", snippet=CONTRACTS_FILE)]
+        current = self.inventory()
+        out = []
+        for section, rule in sorted(self._SECTION_RULE.items()):
+            if rule not in self.codes:
+                continue
+            if committed.get(section) != current.get(section):
+                out.append(self._finding(
+                    rule, path, 1,
+                    f"committed {CONTRACTS_FILE} section "
+                    f"{section!r} is stale (the tree's extracted "
+                    "contract changed) — review the diff and "
+                    "regenerate with --write-contracts",
+                    snippet=f"{CONTRACTS_FILE}:{section}"))
+        return out
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles, one per strongly connected component (plus
+    self-loops): deterministic, and enough for a lint report — the fix
+    (pick one order) collapses the whole SCC anyway."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack[v] = True
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif on_stack.get(w):
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack[w] = False
+                comp.append(w)
+                if w == v:
+                    break
+            sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    cycles = []
+    for comp in sccs:
+        if len(comp) > 1:
+            cycles.append(sorted(comp))
+        elif comp[0] in graph.get(comp[0], ()):
+            cycles.append(comp)  # self-loop: nested re-acquisition
+    return sorted(cycles)
+
+
+# ------------------------------------------------------------ construction
+def repo_root() -> str:
+    return os.path.dirname(default_baseline_path())
+
+
+def default_engine(paths: Sequence[str],
+                   codes: Optional[Iterable[str]] = None
+                   ) -> ContractEngine:
+    """The engine for a CLI invocation: when every scanned path sits
+    inside the repo, contracts are whole-repo (root = repo root, facts
+    from the package + ``tools/`` — a subset scan must not shrink the
+    contract); otherwise (fixture mini-trees) the scanned paths ARE the
+    tree and docs are looked up at their common root."""
+    root = repo_root()
+    abspaths = [os.path.abspath(p) for p in paths]
+    if all(os.path.commonpath([root, p]) == root for p in abspaths
+           if os.path.splitdrive(p)[0] == os.path.splitdrive(root)[0]):
+        pkg = os.path.join(root, "xgboost_tpu")
+        tools = os.path.join(root, "tools")
+        fact_paths = [p for p in (pkg, tools) if os.path.isdir(p)]
+        return ContractEngine(root, fact_paths or [root], codes=codes)
+    common = (abspaths[0] if len(abspaths) == 1
+              else os.path.commonpath(abspaths))
+    if os.path.isfile(common):
+        common = os.path.dirname(common)
+    return ContractEngine(common, abspaths, codes=codes)

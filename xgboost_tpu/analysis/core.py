@@ -377,8 +377,17 @@ def analyze_source(source: str, path: str = "<string>",
 
 
 def run(paths: Sequence[str], baseline: Optional[Baseline] = None,
-        rules: Optional[Sequence] = None) -> Result:
-    """Lint every ``.py`` file under ``paths``."""
+        rules: Optional[Sequence] = None, contracts=None,
+        anchor_filter=None) -> Result:
+    """Lint every ``.py`` file under ``paths``.
+
+    ``contracts`` is an optional
+    :class:`~xgboost_tpu.analysis.contracts.ContractEngine`: its
+    cross-file findings (XGT008-XGT011) merge into the result and flow
+    through the same baseline/exit machinery.  ``anchor_filter`` (a
+    ``Finding -> bool``) drops findings outside a file set of interest
+    — the ``--changed`` pre-commit loop (facts still collect repo-wide;
+    only the REPORTING narrows)."""
     from xgboost_tpu.analysis.rules import all_rules
     if rules is None:
         rules = all_rules()
@@ -398,6 +407,13 @@ def run(paths: Sequence[str], baseline: Optional[Baseline] = None,
         active, sup = analyze_source(source, path, rules)
         findings.extend(active)
         suppressed.extend(sup)
+    if contracts is not None:
+        cactive, csup = contracts.run()
+        findings.extend(cactive)
+        suppressed.extend(csup)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if anchor_filter is not None:
+        findings = [f for f in findings if anchor_filter(f)]
     if baseline is not None:
         new, old = baseline.split(findings)
     else:

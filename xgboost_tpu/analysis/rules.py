@@ -12,6 +12,11 @@ PR and that no generic tool checks:
   XGT006  wall-clock ``time.time()`` used to measure durations
   XGT007  collectives under rank-dependent control flow
 
+The cross-file contract rules XGT008-XGT011 (HTTP route/client parity,
+metric-family drift, knob drift, static lock-order graph) live in
+:mod:`xgboost_tpu.analysis.contracts` — they need whole-repo facts, not
+one file's AST.
+
 Rules are heuristic by design: they aim at THIS tree's hazards, with
 inline ``# xgtpu: disable=`` suppressions (plus the committed baseline)
 as the escape hatch for intentional sites.

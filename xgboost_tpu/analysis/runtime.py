@@ -13,7 +13,11 @@ cause, in real executions under pytest:
   recorded as a violation (the dynamic twin of the static XGT005
   rule), and acquiring two instrumented locks in opposite orders on
   different call paths is recorded as a lock-order inversion (a latent
-  deadlock no single run deadlocks on).
+  deadlock no single run deadlocks on).  The static complement is
+  XGT011 (analysis/contracts.py): the whole-repo nested-acquisition
+  graph sees every LEXICAL order, not just the ones a test executed;
+  tests/test_analysis_contracts.py cross-checks that runtime
+  observations are a subset of that graph.
 
 Both record violations instead of raising at the fault site, so a
 stress test collects everything and fails once with the full report

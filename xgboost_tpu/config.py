@@ -247,7 +247,9 @@ def _coerce(value: Any, current: Any) -> Any:
 # and the module runner (``python -m xgboost_tpu.serving --port ...``)
 # both derive their surfaces from this table, so ``--help``-style
 # discovery stays complete as knobs are added.  Values are
-# (default, help); the default's type drives coercion.
+# (default, help); the default's type drives coercion.  xgtpu-lint
+# XGT010 (ANALYSIS.md v2) enforces that every key here is consumed
+# outside this table — a knob row nothing reads fails tier-1.
 SERVE_PARAMS: Dict[str, Tuple[Any, str]] = {
     "serve_host": ("127.0.0.1", "bind address for the HTTP server"),
     "serve_port": (8080, "HTTP port (0 = ephemeral, printed at startup)"),

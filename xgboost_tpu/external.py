@@ -343,15 +343,18 @@ class ExtMemDMatrix:
         memory — and only genuinely over-budget matrices stream batches
         (the out-of-HBM guarantee: working set is a few page_rows
         batches — up to four with the default prefetcher, one with
-        ``XGTPU_EXT_PREFETCH=0``).
+        ``XGBTPU_EXT_PREFETCH=0``).
 
-        Budget: ``XGTPU_EXT_DEVICE_CACHE_MB`` when set; otherwise HALF
+        Budget: ``XGBTPU_EXT_DEVICE_CACHE_MB`` when set; otherwise HALF
         of the device's currently-free memory (ADVICE r2: a fixed
         default can overcommit small-HBM devices — the other half covers
         the working set: histograms, margins, int32 upcasts of bin ids),
         falling back to 2048MB when the backend reports no stats (CPU)."""
         assert self._binned_mm is not None, "call build_binned first"
-        env = os.environ.get("XGTPU_EXT_DEVICE_CACHE_MB")
+        # canonical XGBTPU_ prefix; the pre-round-8 XGTPU_ spelling is
+        # still honored (it escaped into PROFILE.md-era A/B scripts)
+        env = os.environ.get("XGBTPU_EXT_DEVICE_CACHE_MB",
+                             os.environ.get("XGTPU_EXT_DEVICE_CACHE_MB"))
         if env is not None:
             budget = int(env) << 20
         else:
@@ -372,11 +375,13 @@ class ExtMemDMatrix:
         device-resident (yielded + 2 queued + 1 in-flight put) instead
         of one — still bounded by page_rows, never by data size; the
         default budget's free-HBM halving covers it
-        (:func:`_default_device_budget`).  ``XGTPU_EXT_PREFETCH=0``
+        (:func:`_default_device_budget`).  ``XGBTPU_EXT_PREFETCH=0``
         restores synchronous single-batch staging (the A/B seam and
         the fallback for batches sized near free HBM; round-5
-        measurement in PROFILE.md)."""
-        if os.environ.get("XGTPU_EXT_PREFETCH", "1") == "0":
+        measurement in PROFILE.md; the legacy XGTPU_ spelling still
+        works)."""
+        if os.environ.get("XGBTPU_EXT_PREFETCH",
+                          os.environ.get("XGTPU_EXT_PREFETCH", "1")) == "0":
             for start, b in self.binned_batches():
                 yield start, jnp.asarray(b)
             return

@@ -714,6 +714,13 @@ class ServingMetrics:
         self.latency = self.histogram(
             f"{p}_latency_seconds",
             "request latency, submit to result (includes queueing)")
+        # p50/p99 latency as plain gauges (scrapers that don't do
+        # histogram_quantile still get the headline numbers); refreshed
+        # from the histogram at render time
+        self.latency_p50 = self.gauge(
+            f"{p}_latency_p50_seconds", "p50 request latency")
+        self.latency_p99 = self.gauge(
+            f"{p}_latency_p99_seconds", "p99 request latency")
         registry().register("serving", self._render_own)
 
     # ------------------------------------------------------- constructors
@@ -744,17 +751,11 @@ class ServingMetrics:
         return {q: self.latency.quantile(q) for q in qs}
 
     def _render_own(self) -> str:
+        self.latency_p50.set(self.latency.quantile(0.5))
+        self.latency_p99.set(self.latency.quantile(0.99))
         with self._lock:
             metrics = list(self._metrics.values())
-        parts = [m.render() for m in metrics]
-        # p50/p99 latency as plain gauges (scrapers that don't do
-        # histogram_quantile still get the headline numbers)
-        for q, label in ((0.5, "p50"), (0.99, "p99")):
-            v = self.latency.quantile(q)
-            name = f"{self.prefix}_latency_{label}_seconds"
-            parts.append(f"# HELP {name} {label} request latency\n"
-                         f"# TYPE {name} gauge\n{name} {_fmt(v)}\n")
-        return "".join(parts)
+        return "".join(m.render() for m in metrics)
 
     def render(self) -> str:
         # every other registered group rides along (reliability has

@@ -62,6 +62,25 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(404, json.dumps(
             {"error": f"no route {path}"}).encode(), "application/json")
 
+    def do_POST(self):
+        # the training metrics endpoint is read-only; an unknown POST
+        # gets the same JSON 404 body every handler in the tree sends
+        # (the stdlib default would be a 501 HTML page) — the route
+        # sweep's consistency contract, pinned by
+        # tests/test_analysis_contracts.py.  The body is drained
+        # (bounded) so a keep-alive client's next request line is not
+        # parsed out of the unread payload.
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            length = 0
+        if length > 0:
+            self.rfile.read(min(length, 1 << 20))
+        self.close_connection = True
+        path = self.path.split("?", 1)[0]
+        self._send(404, json.dumps(
+            {"error": f"no route {path}"}).encode(), "application/json")
+
 
 class MetricsServer:
     """Bind + serve the registry from a daemon thread (``stop()`` to
