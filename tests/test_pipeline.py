@@ -1,0 +1,432 @@
+"""Continuous-training pipeline (xgboost_tpu.pipeline, PIPELINE.md).
+
+Acceptance criteria covered here:
+(a) end-to-end train→gate→publish→registry-reload: a published cycle
+    hot-reloads a live ModelRegistry to exactly the gated hash;
+(b) a torn publish (fault-injected; a real SIGKILL mid-publish is
+    strictly weaker — atomic_write leaves old-or-new) and a corrupted
+    candidate BOTH leave the poller serving the incumbent
+    bit-identically, and the next clean cycle publishes;
+(c) a kill mid-train resumes from the checkpoint ring and finishes
+    BIT-identical to an uninterrupted cycle;
+(d) a kill between gate and publish re-gates on restart and then
+    publishes;
+(e) warm-start continuation (``train(init_model=)``) appends rounds
+    bit-identical to one uninterrupted run, and a model reload under a
+    cached DMatrix never mixes tree windows.
+
+Faults are injected through reliability/faults.py inside the REAL
+write/read paths; the subprocess SIGKILL variant lives in
+``tools/chaos_loop.py --pipeline`` (PIPELINE_CHAOS.json).
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.obs.metrics import pipeline_metrics
+from xgboost_tpu.pipeline import (CallableDataSource, ContinuousTrainer,
+                                  EvalGate, FileDataSource, Publisher,
+                                  SyntheticDataSource)
+from xgboost_tpu.reliability import faults
+from xgboost_tpu.serving import ModelRegistry
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.4,
+          "silent": 1}
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    faults.clear_faults()
+    yield
+    faults.clear_faults()
+
+
+def make_trainer(workdir, publish, rounds=2, source=None, gate=None,
+                 params=None):
+    source = source if source is not None else SyntheticDataSource(
+        n_rows=300, n_features=6, seed=0)
+    gate = gate if gate is not None else EvalGate(max_regression=0.5)
+    return ContinuousTrainer(str(publish), source, str(workdir),
+                             rounds_per_cycle=rounds,
+                             params=dict(params or PARAMS), gate=gate,
+                             quiet=True)
+
+
+def make_registry(publish):
+    return ModelRegistry(str(publish), poll_sec=0, warmup=False,
+                         min_bucket=8, max_bucket=16)
+
+
+def file_hash(path):
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def gated_hashes(trainer):
+    try:
+        with open(trainer.gated_log) as f:
+            # tolerate a torn final line (the ledger's crash contract)
+            return [parts[1] for parts in
+                    (line.split() for line in f) if len(parts) >= 2]
+    except OSError:
+        return []
+
+
+def states_equal(a, b):
+    sa, sb = a.gbtree.get_state(), b.gbtree.get_state()
+    assert set(sa) == set(sb)
+    return all(np.array_equal(sa[k], sb[k]) for k in sa)
+
+
+# ------------------------------------------------------------ happy path
+def test_cycles_append_and_registry_reloads_gated_hash(tmp_path):
+    publish = tmp_path / "published.model"
+    tr = make_trainer(tmp_path / "wd", publish, rounds=2)
+    out0 = tr.run_cycle()
+    assert out0["status"] == "published"
+    assert out0["gate"]["reason"] == "no incumbent (cold start)"
+    reg = make_registry(publish)
+    assert reg.content_hash == gated_hashes(tr)[-1]
+
+    out1 = tr.run_cycle()
+    assert out1["status"] == "published"
+    # warm start appended: 2 cycles x 2 rounds
+    bst = xgb.Booster(model_file=str(publish))
+    assert bst.gbtree.num_trees == 4
+    # the live registry hot-reloads to EXACTLY the newly gated hash
+    assert reg.check_reload() is True
+    assert reg.content_hash == gated_hashes(tr)[-1] == file_hash(publish)
+    # the ledger was written BEFORE the publish path ever changed
+    assert len(gated_hashes(tr)) == 2
+    reg.stop()
+
+
+def test_gate_fail_quarantines_and_keeps_incumbent(tmp_path):
+    publish = tmp_path / "published.model"
+    tr = make_trainer(tmp_path / "wd", publish, rounds=2)
+    assert tr.run_cycle()["status"] == "published"
+    before = publish.read_bytes()
+    pm = pipeline_metrics()
+    fails0, q0 = pm.gate_fail.value, pm.quarantines.value
+    tr.gate = EvalGate(min_delta=10.0)  # unmeetable
+    out = tr.run_cycle()
+    assert out["status"] == "gate_failed"
+    assert publish.read_bytes() == before  # incumbent untouched
+    assert os.listdir(tr.quarantine_dir)  # candidate preserved aside
+    assert not os.path.exists(tr.candidate_path)
+    assert pm.gate_fail.value == fails0 + 1
+    assert pm.quarantines.value == q0 + 1
+    assert len(gated_hashes(tr)) == 1  # rejected hash never ledgered
+    # the pipeline keeps training: the NEXT cycle can publish again
+    tr.gate = EvalGate(max_regression=0.5)
+    assert tr.run_cycle()["status"] == "published"
+
+
+# -------------------------------------------------- corruption / torn I/O
+def test_corrupt_candidate_never_published(tmp_path):
+    publish = tmp_path / "published.model"
+    tr = make_trainer(tmp_path / "wd", publish, rounds=2)
+    assert tr.run_cycle()["status"] == "published"
+    reg = make_registry(publish)
+    before = publish.read_bytes()
+    # flip a bit in the candidate as it is written: CRC catches it at
+    # the gate, BEFORE any publish byte moves
+    faults.inject("bit_flip", 256, path_sub="candidate.model")
+    out = tr.run_cycle()
+    assert out["status"] == "gate_failed"
+    assert "failed verification" in out["gate"]["reason"]
+    assert publish.read_bytes() == before
+    assert reg.check_reload() is False  # poller saw nothing
+    assert reg.reload_failures == 0
+    # next clean cycle publishes
+    assert tr.run_cycle()["status"] == "published"
+    assert reg.check_reload() is True
+    assert reg.content_hash == gated_hashes(tr)[-1]
+    reg.stop()
+
+
+def test_torn_publish_invisible_to_poller_then_heals(tmp_path):
+    """The acceptance e2e: a publish whose bytes are torn on disk
+    (strictly worse than a SIGKILL mid-publish, which atomic_write
+    reduces to old-or-new) leaves the registry serving the incumbent
+    bit-identically; the next clean cycle heals the publish path from
+    the incumbent ring replica and publishes."""
+    publish = tmp_path / "published.model"
+    tr = make_trainer(tmp_path / "wd", publish, rounds=2)
+    assert tr.run_cycle()["status"] == "published"
+    reg = make_registry(publish)
+    incumbent_hash = reg.content_hash
+    X = np.random.RandomState(0).rand(16, 6).astype(np.float32)
+    incumbent_pred = np.asarray(reg.engine.predict(X))
+
+    faults.inject("torn_write", 200, path_sub="published.model")
+    out = tr.run_cycle()  # publishes torn bytes (simulated media fault)
+    assert out["status"] == "published"  # the pipeline cannot know yet
+    # the poller CRC-rejects the torn file and keeps the incumbent,
+    # serving BIT-identical predictions
+    assert reg.check_reload() is False
+    assert reg.reload_failures == 1
+    assert reg.content_hash == incumbent_hash
+    assert np.array_equal(np.asarray(reg.engine.predict(X)),
+                          incumbent_pred)
+
+    # next clean cycle: the trainer heals the corrupt publish path from
+    # its verified backup, then trains + publishes a new gated model
+    out2 = tr.run_cycle()
+    assert out2["status"] == "published"
+    assert reg.check_reload() is True
+    assert reg.content_hash == gated_hashes(tr)[-1]
+    # every hash the poller ever built is in the gated ledger
+    assert reg.content_hash in gated_hashes(tr)
+    assert incumbent_hash in gated_hashes(tr)
+    reg.stop()
+
+
+# ------------------------------------------------------- crash recovery
+def test_mid_train_kill_resumes_bit_identical(tmp_path):
+    """A cycle killed mid-train resumes from the checkpoint ring and
+    finishes BIT-identical to an uninterrupted cycle (deterministic
+    data source + continued iteration numbering)."""
+    src = lambda: SyntheticDataSource(n_rows=300, n_features=6, seed=0)
+    # reference: two uninterrupted cycles
+    ref_pub = tmp_path / "ref.model"
+    ref = make_trainer(tmp_path / "ref_wd", ref_pub, rounds=4,
+                       source=src())
+    assert ref.run_cycle()["status"] == "published"
+    assert ref.run_cycle()["status"] == "published"
+
+    pub = tmp_path / "published.model"
+    tr = make_trainer(tmp_path / "wd", pub, rounds=4, source=src())
+    assert tr.run_cycle()["status"] == "published"
+    # "kill" cycle 1 mid-train: the 3rd checkpoint write dies, having
+    # appended 2 rounds to the ring
+    faults.inject("enospc", path_sub="ckpt-000003")
+    summary = tr.run(cycles=1)
+    assert summary["errors"] == 1
+    assert tr._read_state() == {"cycle": 1, "phase": "train"}
+
+    # a fresh process (new trainer instance, same workdir) resumes
+    pm = pipeline_metrics()
+    r0 = pm.resumes.value
+    tr2 = make_trainer(tmp_path / "wd", pub, rounds=4, source=src())
+    assert tr2.run_cycle()["status"] == "published"
+    assert pm.resumes.value == r0 + 1
+    assert states_equal(xgb.Booster(model_file=str(pub)),
+                        xgb.Booster(model_file=str(ref_pub)))
+
+
+def test_regate_on_restart_after_publish_failure(tmp_path):
+    """Killed between gate and publish: the restart re-gates the
+    candidate from its bytes and then publishes."""
+    publish = tmp_path / "published.model"
+    tr = make_trainer(tmp_path / "wd", publish, rounds=2)
+    assert tr.run_cycle()["status"] == "published"
+    before = publish.read_bytes()
+    faults.inject("enospc", path_sub="published.model")
+    summary = tr.run(cycles=1)
+    assert summary["errors"] == 1
+    assert publish.read_bytes() == before  # atomic: old file intact
+    assert tr._read_state() == {"cycle": 1, "phase": "publish"}
+    assert os.path.exists(tr.candidate_path)
+
+    pm = pipeline_metrics()
+    r0 = pm.resumes.value
+    tr2 = make_trainer(tmp_path / "wd", publish, rounds=2)
+    out = tr2.run_cycle()
+    assert out["status"] == "published" and out["cycle"] == 1
+    assert pm.resumes.value == r0 + 1
+    assert file_hash(publish) == out["gate"]["model_hash"]
+    assert tr2._read_state() == {"cycle": 2, "phase": "train"}
+
+
+def test_crash_after_publish_before_advance_finalizes(tmp_path):
+    """Killed BETWEEN a completed publish and the cursor advance: the
+    candidate is now the incumbent, and a strict-improvement gate
+    (min_delta > 0) re-gating it against itself would quarantine the
+    live model — the restart must recognize the completed publish and
+    finalize instead."""
+    publish = tmp_path / "published.model"
+    tr = make_trainer(tmp_path / "wd", publish, rounds=2,
+                      gate=EvalGate(min_delta=0.001))
+    assert tr.run_cycle()["status"] == "published"  # cold start
+    # recreate the crash window: published bytes back as the candidate,
+    # cursor still at phase "publish"
+    with open(tr.candidate_path, "wb") as f:
+        f.write(publish.read_bytes())
+    tr._write_state({"cycle": 0, "phase": "publish"})
+    backup_before = open(tr.backup_path, "rb").read()
+
+    pm = pipeline_metrics()
+    q0, gf0, r0 = (pm.quarantines.value, pm.gate_fail.value,
+                   pm.resumes.value)
+    tr2 = make_trainer(tmp_path / "wd", publish, rounds=2,
+                       gate=EvalGate(min_delta=0.001))
+    out = tr2.run_cycle()
+    assert out["status"] == "published" and out["resumed"] is True
+    assert pm.resumes.value == r0 + 1
+    # the live model was NOT quarantined or gate-failed
+    assert pm.quarantines.value == q0
+    assert pm.gate_fail.value == gf0
+    assert not os.path.exists(tr2.quarantine_dir)
+    # epilogue completed: backup refreshed, cursor advanced
+    assert open(tr2.backup_path, "rb").read() == backup_before
+    assert tr2._read_state() == {"cycle": 1, "phase": "train"}
+
+
+def test_idle_when_no_fresh_data(tmp_path):
+    publish = tmp_path / "published.model"
+    src = CallableDataSource(lambda cycle: None)
+    tr = make_trainer(tmp_path / "wd", publish, source=src)
+    assert tr.run_cycle()["status"] == "idle"
+    assert not os.path.exists(publish)
+
+
+def test_regate_survives_rotated_train_file(tmp_path):
+    """The re-gate needs ONLY the holdout: a producer that rotated the
+    cycle's fresh train file away between the kill and the restart
+    must not wedge the recovery."""
+    def write_svm(path, seed, n=150):
+        rng = np.random.RandomState(seed)
+        X = rng.rand(n, 4)
+        y = (X[:, 0] > 0.5).astype(int)
+        with open(path, "w") as f:
+            for i in range(n):
+                f.write(f"{y[i]} " + " ".join(
+                    f"{j}:{X[i, j]:.6f}" for j in range(4)) + "\n")
+    write_svm(tmp_path / "fresh-0.libsvm", 1)
+    write_svm(tmp_path / "holdout.libsvm", 9)
+    publish = tmp_path / "published.model"
+
+    def src():
+        return FileDataSource(str(tmp_path / "fresh-{cycle}.libsvm"),
+                              str(tmp_path / "holdout.libsvm"))
+    tr = make_trainer(tmp_path / "wd", publish, rounds=2, source=src())
+    assert tr.run_cycle()["status"] == "published"
+    write_svm(tmp_path / "fresh-1.libsvm", 2)
+    faults.inject("enospc", path_sub="published.model")
+    assert tr.run(cycles=1)["errors"] == 1  # died at publish
+    faults.clear_faults()
+    os.remove(tmp_path / "fresh-1.libsvm")  # producer rotated it away
+
+    tr2 = make_trainer(tmp_path / "wd", publish, rounds=2, source=src())
+    out = tr2.run_cycle()
+    assert out["status"] == "published" and out["cycle"] == 1
+
+
+# --------------------------------------------------------- file source
+def test_file_datasource_cycle_substitution(tmp_path):
+    def write_svm(path, seed, n=120):
+        rng = np.random.RandomState(seed)
+        X = rng.rand(n, 4)
+        y = (X[:, 0] > 0.5).astype(int)
+        with open(path, "w") as f:
+            for i in range(n):
+                f.write(f"{y[i]} " + " ".join(
+                    f"{j}:{X[i, j]:.6f}" for j in range(4)) + "\n")
+    write_svm(tmp_path / "fresh-0.libsvm", 1)
+    write_svm(tmp_path / "holdout.libsvm", 9)
+    src = FileDataSource(str(tmp_path / "fresh-{cycle}.libsvm"),
+                         str(tmp_path / "holdout.libsvm"))
+    d0 = src.next_cycle(0)
+    assert d0 is not None and d0[0].num_row == 120
+    # holdout is cached across cycles (same object)
+    write_svm(tmp_path / "fresh-1.libsvm", 2)
+    assert src.next_cycle(1)[1] is d0[1]
+    assert src.next_cycle(2) is None  # no fresh-2 yet: idle
+
+
+# ----------------------------------------------------------- warm start
+def test_train_init_model_continuation_bit_identical(tmp_path):
+    """train(init_model=) appends rounds whose iteration numbering
+    (fold_in seeding, subsample draws) continues the loaded ensemble's
+    — the continued model is bit-identical to one uninterrupted run."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(400, 6).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    p = {**PARAMS, "subsample": 0.7, "seed": 5}
+    full = xgb.train(p, d, 6)
+
+    half = xgb.train(p, xgb.DMatrix(X, label=y), 3)
+    path = str(tmp_path / "half.model")
+    half.save_model(path)
+    cont = xgb.train(p, xgb.DMatrix(X, label=y), 3, init_model=path)
+    assert cont.gbtree.num_trees == 6
+    assert states_equal(full, cont)
+    assert np.array_equal(full.predict(d), cont.predict(d))
+
+
+def test_train_rejects_both_aliases(tmp_path):
+    X = np.random.RandomState(0).rand(50, 4).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(PARAMS, d, 1)
+    with pytest.raises(ValueError, match="not both"):
+        xgb.train(PARAMS, d, 1, xgb_model=bst, init_model=bst)
+
+
+def test_reload_under_cached_dmatrix_never_mixes_windows(tmp_path):
+    """predict_incremental state after a model reload: the cached
+    margin must rebuild for the NEW ensemble, not mix tree windows."""
+    rng = np.random.RandomState(1)
+    X = rng.rand(200, 5).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(PARAMS, d, 3, evals=[(d, "train")],
+                    verbose_eval=False)
+    small = str(tmp_path / "small.model")
+    bst.save_model(small)
+    # keep training the same booster on the same cached DMatrix
+    bst2 = xgb.train(PARAMS, d, 3, xgb_model=bst, evals=[(d, "train")],
+                     verbose_eval=False)
+    assert bst2.gbtree.num_trees == 6
+    # hot-reload the SMALLER model into the live booster: predictions
+    # on the still-cached DMatrix must equal a fresh load's
+    bst2.load_model(small)
+    fresh = xgb.Booster(model_file=small)
+    assert np.array_equal(bst2.predict(d), fresh.predict(d))
+    # belt: an ensemble swapped in directly (no cache clear) must also
+    # never serve a margin folding more trees than exist
+    bst3 = xgb.train(PARAMS, d, 3, evals=[(d, "train")],
+                     verbose_eval=False)
+    entry = bst3._entry(d)
+    assert entry.applied == bst3.gbtree.num_trees
+    bst3.gbtree = fresh.gbtree  # smaller ensemble, cache NOT cleared
+    bst3._sync_margin(entry)
+    assert entry.applied == fresh.gbtree.num_trees
+    assert np.array_equal(bst3.predict(d), fresh.predict(d))
+
+
+# ------------------------------------------------------------ CLI + obs
+def test_cli_usage_lists_pipeline_params(capsys):
+    from xgboost_tpu.cli import main as cli_main
+    from xgboost_tpu.config import PIPELINE_PARAMS
+    assert cli_main([]) == 0
+    out = capsys.readouterr().out
+    assert "pipeline" in out
+    for name in PIPELINE_PARAMS:
+        assert name in out, f"{name} missing from CLI usage"
+
+
+def test_pipeline_metrics_families_render():
+    pm = pipeline_metrics()
+    text = pm.render()
+    for fam in ("xgbtpu_pipeline_cycles_total",
+                "xgbtpu_pipeline_cycle_seconds",
+                "xgbtpu_pipeline_gate_pass_total",
+                "xgbtpu_pipeline_gate_fail_total",
+                "xgbtpu_pipeline_publishes_total",
+                "xgbtpu_pipeline_publish_failures_total",
+                "xgbtpu_pipeline_publish_seconds_total",
+                "xgbtpu_pipeline_trees_published_total",
+                "xgbtpu_pipeline_quarantines_total",
+                "xgbtpu_pipeline_resumes_total",
+                "xgbtpu_pipeline_incumbent_age_seconds"):
+        assert f"# TYPE {fam} " in text, fam
+    # the group rides the process-wide registry (one scrape covers it)
+    from xgboost_tpu.obs import registry
+    assert "xgbtpu_pipeline_cycles_total" in registry().render()

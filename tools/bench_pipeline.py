@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Cycle-latency smoke bench for the continuous-training pipeline.
+
+Drives an in-process :class:`~xgboost_tpu.pipeline.ContinuousTrainer`
+over the deterministic synthetic source for a few cycles and reports
+the cycle-loop economics: wall seconds per cycle, the publish's share
+of it, and the gate verdict mix.  This is a SMOKE bench (is the cycle
+loop sanely fast, did a change regress it 10x), not a training bench —
+bench.py owns rows/sec.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_pipeline.py --cycles 4
+
+Emits ``BENCH_pipeline.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cycles", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--features", type=int, default=16)
+    ap.add_argument("--out", default="BENCH_pipeline.json")
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from xgboost_tpu.obs.metrics import pipeline_metrics
+    from xgboost_tpu.pipeline import (ContinuousTrainer, EvalGate,
+                                      SyntheticDataSource)
+
+    work = args.workdir or tempfile.mkdtemp(prefix="xgbtpu_benchpipe_")
+    publish = os.path.join(work, "published.model")
+    trainer = ContinuousTrainer(
+        publish, SyntheticDataSource(n_rows=args.rows,
+                                     n_features=args.features, seed=0),
+        os.path.join(work, "wd"), rounds_per_cycle=args.rounds,
+        params={"objective": "binary:logistic", "max_depth": 4,
+                "eta": 0.3, "silent": 1},
+        gate=EvalGate(max_regression=0.1), quiet=True)
+
+    pm = pipeline_metrics()
+    base = {"publish_s": pm.publish_seconds.value,
+            "pass": pm.gate_pass.value, "fail": pm.gate_fail.value,
+            "published": pm.publishes.value}
+    cycle_s = []
+    statuses = []
+    for _ in range(args.cycles):
+        t0 = time.perf_counter()
+        out = trainer.run_cycle()
+        cycle_s.append(time.perf_counter() - t0)
+        statuses.append(out["status"])
+        print(f"[bench-pipe] cycle {out['cycle']}: {out['status']} "
+              f"in {cycle_s[-1]:.3f}s", file=sys.stderr)
+
+    report = {
+        "backend": jax.default_backend(),
+        "cycles": args.cycles,
+        "rounds_per_cycle": args.rounds,
+        "rows_per_cycle": args.rows,
+        "features": args.features,
+        "statuses": statuses,
+        "cycle_seconds": [round(s, 4) for s in cycle_s],
+        "cycle_seconds_mean": round(sum(cycle_s) / len(cycle_s), 4),
+        "cycle_seconds_steady": round(
+            sum(cycle_s[1:]) / max(len(cycle_s) - 1, 1), 4),
+        "publish_seconds_total": round(
+            pm.publish_seconds.value - base["publish_s"], 4),
+        "gate_pass": pm.gate_pass.value - base["pass"],
+        "gate_fail": pm.gate_fail.value - base["fail"],
+        "published": pm.publishes.value - base["published"],
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"[bench-pipe] steady-state cycle "
+          f"{report['cycle_seconds_steady']}s "
+          f"({report['published']:.0f} published) -> {args.out}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

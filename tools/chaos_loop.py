@@ -27,6 +27,19 @@ the fleet contract: ZERO failed non-shed requests — every client
 request either succeeds (the router's retry-once path absorbs replica
 deaths) or is an explicit 503 shed.  Emits ``CHAOS_fleet.json``.
 
+``--pipeline`` switches to the CONTINUOUS-TRAINING chaos mode
+(PIPELINE.md): a shared-model fleet (every replica polls the pipeline's
+publish path) serves live traffic while ``task=pipeline`` subprocesses
+train→gate→publish fresh cycles — and the driver SIGKILLs the pipeline
+process at random moments and randomly arms bit-flip/torn-write faults
+on the candidate, the checkpoint ring, and the publish path.  A hash
+watcher scrapes every replica's ``/healthz`` ``model_hash``
+continuously; the contract asserted is **zero unverified or ungated
+models ever observed by a serving replica**: every hash a replica
+serves must be the initial seed model or a hash recorded in the
+pipeline's fsync'd ``gated.log`` ledger BEFORE its publish began.
+Emits ``PIPELINE_CHAOS.json``.
+
 Also runs as a slow-marked test
 (tests/test_reliability.py::test_chaos_loop_driver).
 """
@@ -188,6 +201,202 @@ def fleet_mode(args) -> int:
     return 0
 
 
+def pipeline_mode(args) -> int:
+    """Continuous-training chaos: SIGKILL/corrupt the train→gate→
+    publish→reload boundary under live fleet traffic (see module
+    docstring).  Contract: zero unverified or ungated models ever
+    observed by a serving replica."""
+    import hashlib
+    import subprocess
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from launch_fleet import FleetLauncher, RetryingPredictClient
+
+    import xgboost_tpu as xgb
+
+    work = args.workdir or tempfile.mkdtemp(prefix="xgbtpu_chaospipe_")
+    os.makedirs(work, exist_ok=True)
+    rng = np.random.RandomState(args.seed)
+    cycles = args.pipe_cycles
+
+    # fresh data per cycle + the fixed holdout window
+    holdout = os.path.join(work, "holdout.libsvm")
+    _write_libsvm(holdout, n=400, f=6, seed=999)
+    for c in range(cycles):
+        _write_libsvm(os.path.join(work, f"fresh-{c}.libsvm"),
+                      n=400, f=6, seed=100 + c)
+
+    # seed incumbent, published before the fleet boots
+    publish = os.path.join(work, "published.model")
+    X0 = np.random.RandomState(7).rand(400, 6).astype(np.float32)
+    y0 = (X0[:, 0] > 0.5).astype(np.float32)
+    xgb.train({"objective": "binary:logistic", "max_depth": 3,
+               "eta": 0.4, "silent": 1},
+              xgb.DMatrix(X0, label=y0), 3).save_model(publish)
+    with open(publish, "rb") as f:
+        initial_hash = hashlib.sha256(f.read()).hexdigest()
+    wd = os.path.join(work, "wd")
+
+    fl = FleetLauncher(
+        publish, replicas=args.fleet_replicas, shared_model=True,
+        workdir=os.path.join(work, "fleet"),
+        serve_args=["serve_min_bucket=8", "serve_max_bucket=32",
+                    "serve_max_wait_ms=1.0", "serve_poll_sec=0.25"],
+        router_kwargs={"lease_sec": 3.0, "hc_sec": 0.5}, quiet=True)
+    fl.start()
+    try:
+        print(f"[chaos-pipe] waiting for {args.fleet_replicas} "
+              "replicas...", file=sys.stderr)
+        fl.wait_ready()
+        replica_urls = [m["url"] for m in fl.members()["replicas"]]
+    except BaseException:
+        fl.stop()
+        raise
+
+    observed = set()
+    counts = {"ok": 0, "shed": 0, "fail": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def watcher():
+        # the contract's witness: what hash is each replica SERVING,
+        # sampled continuously across every reload boundary
+        while not stop.is_set():
+            for u in replica_urls:
+                try:
+                    with urllib.request.urlopen(u + "/healthz",
+                                                timeout=2) as r:
+                        h = json.load(r).get("model_hash")
+                except (OSError, ValueError):
+                    continue
+                if h:
+                    with lock:
+                        observed.add(h)
+            time.sleep(0.05)
+
+    body = ",".join(f"{v:.6f}" for v in X0[0]).encode()
+
+    def client():
+        conn = RetryingPredictClient(fl.url)
+        mine = {"ok": 0, "shed": 0, "fail": 0}
+        while not stop.is_set():
+            status, _ = conn.post(body)
+            key = ("ok" if status == 200
+                   else "shed" if status == 503 else "fail")
+            mine[key] += 1
+        conn.close()
+        with lock:
+            for k in counts:
+                counts[k] += mine[k]
+
+    threads = [threading.Thread(target=watcher)] + [
+        threading.Thread(target=client) for _ in range(2)]
+    for t in threads:
+        t.start()
+
+    def cursor() -> int:
+        try:
+            with open(os.path.join(wd, "state.json")) as f:
+                return int(json.load(f).get("cycle", 0))
+        except (OSError, ValueError):
+            return 0
+
+    # the chaos menu: faults armed (via env) on a random subset of the
+    # train→gate→publish boundary's write/read seams
+    fault_menu = [None, None,  # half the attempts run fault-free
+                  "bit_flip=256@candidate.model",
+                  "torn_write=128@candidate.model",
+                  "bit_flip=300@published.model",
+                  "torn_write=200@ckpt-",
+                  "read_flip=64@published.model"]
+    pipe_cmd_base = [
+        sys.executable, "-m", "xgboost_tpu", "task=pipeline",
+        f"pipeline_publish_path={publish}", f"pipeline_dir={wd}",
+        f"pipeline_data={os.path.join(work, 'fresh-{cycle}.libsvm')}",
+        f"pipeline_holdout={holdout}", "pipeline_rounds_per_cycle=3",
+        "pipeline_max_regression=0.2", "objective=binary:logistic",
+        "max_depth=3", "eta=0.4", "silent=1"]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    kills = faults_armed = attempts = 0
+    log = open(os.path.join(work, "pipeline.log"), "ab")
+    try:
+        while cursor() < cycles and attempts < cycles * 5:
+            attempts += 1
+            remaining = cycles - cursor()
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            fault = fault_menu[rng.randint(len(fault_menu))]
+            if fault:
+                env["XGBTPU_FAULTS"] = fault
+                faults_armed += 1
+            p = subprocess.Popen(
+                pipe_cmd_base + [f"pipeline_cycles={remaining}"],
+                stdout=log, stderr=log, cwd=repo, env=env)
+            # SIGKILL at a random moment inside the attempt — startup,
+            # mid-train, mid-gate, mid-publish, mid-reload all get hit
+            # across runs
+            deadline = time.perf_counter() + float(rng.uniform(4.0, 25.0))
+            while time.perf_counter() < deadline and p.poll() is None:
+                time.sleep(0.25)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+                kills += 1
+                print(f"[chaos-pipe] SIGKILL attempt {attempts} "
+                      f"(fault={fault}, cursor={cursor()})",
+                      file=sys.stderr)
+            else:
+                print(f"[chaos-pipe] attempt {attempts} exited "
+                      f"rc={p.returncode} (fault={fault}, "
+                      f"cursor={cursor()})", file=sys.stderr)
+        # let the pollers observe the final publish before teardown
+        time.sleep(1.5)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        fl.stop()
+        log.close()
+
+    gated = set()
+    try:
+        with open(os.path.join(wd, "gated.log")) as f:
+            # a SIGKILL can tear the final ledger line (the append-only
+            # contract); a one-token tail is expected, not a crash
+            gated = {parts[1] for parts in
+                     (line.split() for line in f) if len(parts) >= 2}
+    except OSError:
+        pass
+    allowed = gated | {initial_hash}
+    violations = sorted(observed - allowed)
+    report = {
+        "mode": "pipeline", "cycles": cycles,
+        "cycles_completed": cursor(), "attempts": attempts,
+        "kills": kills, "faults_armed": faults_armed,
+        "replicas": args.fleet_replicas,
+        "gated_hashes": len(gated),
+        "observed_hashes": len(observed),
+        "published_observed": len(observed & gated),
+        "ungated_or_unverified_observed": len(violations),
+        "violations": violations, **counts,
+        "non_shed_failures": counts["fail"],
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"[chaos-pipe] {report['cycles_completed']}/{cycles} cycles, "
+          f"{kills} kills, {faults_armed} faults, "
+          f"{len(observed)} hashes observed "
+          f"({len(violations)} VIOLATIONS), {counts['ok']} ok / "
+          f"{counts['fail']} failed requests -> {args.out}",
+          file=sys.stderr)
+    ok = (not violations and counts["fail"] == 0
+          and report["cycles_completed"] >= cycles
+          and report["published_observed"] >= 1 and kills >= 1)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--runs", type=int, default=10)
@@ -204,9 +413,19 @@ def main(argv=None) -> int:
                     help="--fleet: how long to drive traffic")
     ap.add_argument("--kill-every", type=float, default=4.0,
                     help="--fleet: seconds between replica kills")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="continuous-training mode: SIGKILL/corrupt "
+                         "the train→gate→publish→reload boundary under "
+                         "live fleet traffic (see module docstring)")
+    ap.add_argument("--pipe-cycles", type=int, default=4,
+                    help="--pipeline: cycles the pipeline must complete")
     args = ap.parse_args(argv)
     if args.out is None:
-        args.out = "CHAOS_fleet.json" if args.fleet else "CHAOS.json"
+        args.out = ("PIPELINE_CHAOS.json" if args.pipeline
+                    else "CHAOS_fleet.json" if args.fleet
+                    else "CHAOS.json")
+    if args.pipeline:
+        return pipeline_mode(args)
     if args.fleet:
         return fleet_mode(args)
 

@@ -97,8 +97,15 @@ class FleetLauncher:
                  port: int = 0, featurestore_mb: float = 0.0,
                  serve_args: Optional[List[str]] = None,
                  router_kwargs: Optional[dict] = None,
-                 quiet: bool = True):
+                 quiet: bool = True, shared_model: bool = False):
         self.model_path = model_path
+        # shared_model: every replica polls the SAME file (the
+        # continuous-training pipeline's publish path) instead of a
+        # per-replica copy — the blind-swap reload lane, where one
+        # atomic publish hot-reloads the whole fleet (PIPELINE.md);
+        # per-replica copies remain the default (canary rollouts stage
+        # per replica)
+        self.shared_model = bool(shared_model)
         self.n = int(replicas)
         self.workdir = workdir
         self.host = host
@@ -117,6 +124,8 @@ class FleetLauncher:
         return f"http://{self.router.host}:{self.router.port}"
 
     def replica_model(self, i: int) -> str:
+        if self.shared_model:
+            return self.model_path
         return os.path.join(self.workdir, f"replica-{i}", "model.bin")
 
     def _replica_cmd(self, i: int) -> List[str]:
@@ -142,6 +151,8 @@ class FleetLauncher:
         from xgboost_tpu.fleet import run_router
         os.makedirs(self.workdir, exist_ok=True)
         for i in range(self.n):
+            if self.shared_model:
+                continue  # all replicas poll model_path itself
             os.makedirs(os.path.dirname(self.replica_model(i)),
                         exist_ok=True)
             shutil.copyfile(self.model_path, self.replica_model(i))

@@ -356,7 +356,8 @@ def _collect_param_table(ctx: FileContext, node, facts: Facts) -> None:
         name = node.target.id
     else:
         return
-    table = {"SERVE_PARAMS": "serve", "FLEET_PARAMS": "fleet"}.get(name)
+    table = {"SERVE_PARAMS": "serve", "FLEET_PARAMS": "fleet",
+             "PIPELINE_PARAMS": "pipeline"}.get(name)
     if table is None or not isinstance(node.value, ast.Dict):
         return
     for k in node.value.keys:
@@ -751,7 +752,8 @@ class ContractEngine:
         for _, fam, label, _ in sorted(
                 facts.families, key=lambda t: (t[0], t[1], t[3])):
             families.setdefault(fam, label)
-        params: Dict[str, List[str]] = {"serve": [], "fleet": []}
+        params: Dict[str, List[str]] = {"serve": [], "fleet": [],
+                                        "pipeline": []}
         for _, table, key, _ in facts.params:
             if key not in params[table]:
                 params[table].append(key)

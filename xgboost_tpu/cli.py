@@ -28,8 +28,8 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
-from xgboost_tpu.config import (FLEET_PARAMS, SERVE_PARAMS,
-                                parse_config_file)
+from xgboost_tpu.config import (FLEET_PARAMS, PIPELINE_PARAMS,
+                                SERVE_PARAMS, parse_config_file)
 
 # process start, for recovery-cost accounting.  perf_counter, not
 # wall-clock: these readings are only ever subtracted (XGT006)
@@ -51,6 +51,13 @@ Tasks (task=...):
           least-loaded (/predict) or consistent-hash (/predict_by_id),
           with circuit breakers, load shedding, and canary rollout
           (quickstart: tools/launch_fleet.py)
+  pipeline
+          continuous training (xgboost_tpu.pipeline, PIPELINE.md):
+          warm-start from the published model, append
+          pipeline_rounds_per_cycle trees on fresh data, gate the
+          candidate against the incumbent on a holdout, and atomically
+          publish to the path the serving tier polls — directly or
+          through the fleet canary lane (pipeline_router_url=)
 
 Observability (OBSERVABILITY.md): obs_log=PATH appends a crash-safe
 JSONL timeline (render: tools/obs_report.py); metrics_port=N serves
@@ -61,6 +68,9 @@ task=serve parameters:
 
 task=fleet_router parameters:
 {fleet_params}
+
+task=pipeline parameters:
+{pipeline_params}
 """
 
 
@@ -101,6 +111,8 @@ class BoostLearnTask:
         # tables (single source of truth for both CLI surfaces)
         self.serve_params = {k: v for k, (v, _) in SERVE_PARAMS.items()}
         self.fleet_params = {k: v for k, (v, _) in FLEET_PARAMS.items()}
+        self.pipeline_params = {k: v
+                                for k, (v, _) in PIPELINE_PARAMS.items()}
 
     # ------------------------------------------------------------- params
     _OWN = {
@@ -163,6 +175,8 @@ class BoostLearnTask:
             self.serve_params[name] = type(SERVE_PARAMS[name][0])(val)
         elif name in self.fleet_params:
             self.fleet_params[name] = type(FLEET_PARAMS[name][0])(val)
+        elif name in self.pipeline_params:
+            self.pipeline_params[name] = type(PIPELINE_PARAMS[name][0])(val)
         else:
             m = re.match(r"eval\[([^\]]+)\]", name)
             if m:
@@ -177,9 +191,11 @@ class BoostLearnTask:
     def run(self, argv: List[str]) -> int:
         if not argv:
             from xgboost_tpu.config import (fleet_params_help,
+                                            pipeline_params_help,
                                             serve_params_help)
             print(_USAGE.format(serve_params=serve_params_help(),
-                                fleet_params=fleet_params_help()))
+                                fleet_params=fleet_params_help(),
+                                pipeline_params=pipeline_params_help()))
             return 0
         if os.path.exists(argv[0]) or "=" not in argv[0]:
             for name, val in parse_config_file(argv[0]):
@@ -269,7 +285,7 @@ class BoostLearnTask:
                 obs_path = f"{obs_path}.rank{self.rank}"
             obs.configure_log(obs_path)
         port = int(params.get("metrics_port", -1))
-        if port >= 0 and self.task == "train":
+        if port >= 0 and self.task in ("train", "pipeline"):
             srv = obs.start_metrics_server(
                 port=port + self.rank if port > 0 else 0,
                 rank=self.rank)
@@ -319,6 +335,8 @@ class BoostLearnTask:
             return self.task_serve()
         if self.task == "fleet_router":
             return self.task_fleet_router()
+        if self.task == "pipeline":
+            return self.task_pipeline()
         raise ValueError(f"unknown task {self.task!r}")
 
     # ------------------------------------------------------------- helpers
@@ -566,6 +584,34 @@ class BoostLearnTask:
             },
             quiet=self.silent != 0, block=True)
         return 0
+
+    # ----------------------------------------------------------- pipeline
+    def task_pipeline(self) -> int:
+        """Run the continuous-training loop (xgboost_tpu.pipeline,
+        PIPELINE.md): train → gate → publish against the model file the
+        serving tier polls.  ``pipeline_data`` falls back to ``data=``;
+        learner hyperparameters (objective, max_depth, ...) pass
+        through like ``task=train``."""
+        from xgboost_tpu.pipeline import run_pipeline
+        pp = self.pipeline_params
+        summary = run_pipeline(
+            pp["pipeline_publish_path"],
+            workdir=pp["pipeline_dir"],
+            data=pp["pipeline_data"] or self.train_path,
+            holdout=pp["pipeline_holdout"],
+            rounds_per_cycle=pp["pipeline_rounds_per_cycle"],
+            cycles=pp["pipeline_cycles"],
+            metric=pp["pipeline_metric"],
+            min_delta=pp["pipeline_min_delta"],
+            max_regression=pp["pipeline_max_regression"],
+            router_url=pp["pipeline_router_url"],
+            publish_timeout_sec=pp["pipeline_publish_timeout_sec"],
+            sleep_sec=pp["pipeline_sleep_sec"],
+            params=self._params_dict(),
+            quiet=self.silent != 0)
+        if self.silent < 2:
+            print(f"[pipeline] done: {summary}", file=sys.stderr)
+        return 0 if summary.get("errors", 0) == 0 else 1
 
     # -------------------------------------------------------------- dump
     def task_dump(self) -> int:

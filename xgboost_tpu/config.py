@@ -343,6 +343,59 @@ def fleet_params_help() -> str:
                      for name, (default, help_) in FLEET_PARAMS.items())
 
 
+# ------------------------------------------------------------- pipeline
+# task=pipeline parameters (xgboost_tpu.pipeline, PIPELINE.md) — same
+# single-table discipline as SERVE_PARAMS/FLEET_PARAMS: the classic CLI
+# derives its surface from this dict, xgtpu-lint XGT010 enforces that
+# every key is consumed outside config.py, and the inventory rides
+# ANALYSIS_CONTRACTS.json.
+PIPELINE_PARAMS: Dict[str, Tuple[Any, str]] = {
+    "pipeline_publish_path": ("", "model file the serving tier polls; "
+                                  "each gated candidate is atomically "
+                                  "published here (REQUIRED; also the "
+                                  "warm-start incumbent)"),
+    "pipeline_dir": ("./pipeline", "pipeline working directory: cycle "
+                                   "state, candidate model, checkpoint "
+                                   "ring, quarantine, gated-hash "
+                                   "ledger"),
+    "pipeline_rounds_per_cycle": (5, "boosting rounds appended to the "
+                                     "incumbent per cycle"),
+    "pipeline_cycles": (1, "cycles to run before exiting (0 = run "
+                           "forever)"),
+    "pipeline_data": ("", "fresh training data per cycle; a {cycle} "
+                          "placeholder substitutes the cycle index "
+                          "(falls back to data=)"),
+    "pipeline_holdout": ("", "held-out eval window the gate scores "
+                             "candidate vs incumbent on (REQUIRED "
+                             "unless a custom DataSource provides "
+                             "one)"),
+    "pipeline_metric": ("", "gate metric name (empty = the "
+                            "objective's default metric)"),
+    "pipeline_min_delta": (0.0, "gate: minimum improvement over the "
+                                "incumbent required to publish "
+                                "(> 0 demands strict improvement)"),
+    "pipeline_max_regression": (0.0, "gate: tolerated worsening vs the "
+                                     "incumbent when pipeline_min_delta "
+                                     "<= 0 (fresh-data drift allowance)"),
+    "pipeline_router_url": ("", "fleet router base URL: publish through "
+                                "the canary rollout lane (POST "
+                                "/fleet/rollout) instead of a direct "
+                                "atomic swap (empty = direct)"),
+    "pipeline_publish_timeout_sec": (600.0, "rollout-lane publish "
+                                            "timeout; must outlive the "
+                                            "router's canary soak "
+                                            "window"),
+    "pipeline_sleep_sec": (0.0, "pause between cycles (and after an "
+                                "idle cycle with no fresh data)"),
+}
+
+
+def pipeline_params_help() -> str:
+    """One line per task=pipeline parameter, for CLI usage text."""
+    return "\n".join(f"  {name:<26} {help_} (default {default!r})"
+                     for name, (default, help_) in PIPELINE_PARAMS.items())
+
+
 def parse_config_file(path: str) -> List[Tuple[str, str]]:
     """Parse a ``name = value`` config file.
 

@@ -92,6 +92,10 @@ class Booster:
         self.best_iteration: int = -1
         self.best_score: float = float("nan")
         self.attributes: Dict[str, str] = {}
+        # bumped on every whole-model replacement (load_model/load_raw):
+        # cache entries stamp it so a margin can never fold trees of two
+        # different loaded ensembles (the hot-reload window-mixing guard)
+        self._model_gen = 0
         self._mesh = None                  # resolved at _lazy_init (dsplit=row)
         self._col_mesh = None              # resolved at _lazy_init (dsplit=col)
         self._pending_cache = list(cache)  # bound at _lazy_init (needs cuts)
@@ -274,11 +278,22 @@ class Booster:
 
     def _entry(self, dmat: DMatrix) -> _CacheEntry:
         key = id(dmat)
+        if (key in self._cache
+                and getattr(self._cache[key], "model_gen", 0)
+                != self._model_gen):
+            # the whole model was replaced (registry hot-reload /
+            # load_model) since this entry was built: its incremental
+            # margin folds the OLD ensemble's trees — rebuild rather
+            # than mix tree windows (load_raw also clears the cache;
+            # this stamp is the belt for entries handed out earlier or
+            # a gbtree swapped in directly)
+            del self._cache[key]
         if (key in self._cache and self._cache[key].external
                 and dmat._binned_cuts is not self.gbtree.cuts):
             # another model re-quantized this matrix meanwhile: re-bin and
             # rebuild our margins from scratch
             self._cache[key] = self._build_ext_entry(dmat)
+            self._cache[key].model_gen = self._model_gen
         if (key in self._cache
                 and self._cache[key].info is not dmat.info
                 and self._cache[key].info_version != dmat.info.version):
@@ -374,6 +389,7 @@ class Booster:
                         else jnp.asarray(bt)
                 self._cache[key] = entry
             self._attach_root(self._cache[key], dmat)
+            self._cache[key].model_gen = self._model_gen
         entry = self._cache[key]
         if (entry.info is dmat.info
                 and entry.info_version != dmat.info.version):
@@ -704,6 +720,14 @@ class Booster:
         if entry.external:
             self._sync_margin_ext(entry)
             return
+        if (self.param.booster != "gblinear"
+                and entry.applied > self.gbtree.num_trees):
+            # the ensemble SHRANK under this entry (a reload to an
+            # older model, or an ntree window raced a swap): the cached
+            # margin folds trees that no longer exist — rebuild from
+            # base instead of serving a mixed window
+            entry.margin = None
+            entry.applied = 0
         if entry.margin is None:
             entry.margin = jnp.broadcast_to(
                 entry.base, (entry.binned.shape[0], self._K)).astype(jnp.float32)
@@ -1544,6 +1568,7 @@ class Booster:
             from xgboost_tpu.models.gbtree import GBTree
             self.gbtree = GBTree.from_state(self.param, state)
         self._cache.clear()
+        self._model_gen += 1
 
     def _load_reference(self, src):
         """Adopt the state of a reference-format model (path or bytes)."""
@@ -1554,6 +1579,7 @@ class Booster:
         self.gbtree = other.gbtree
         self.num_feature = other.num_feature
         self._cache.clear()
+        self._model_gen += 1
 
     def save_raw(self) -> bytes:
         import io
@@ -1639,15 +1665,34 @@ def train(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
           maximize: Optional[bool] = None,
           early_stopping_rounds: Optional[int] = None,
           evals_result: Optional[dict] = None, verbose_eval: bool = True,
-          xgb_model=None) -> Booster:
+          xgb_model=None, init_model=None) -> Booster:
     """Train a booster (reference wrapper/xgboost.py:533-632, including the
     early-stopping protocol: best_score/best_iteration attributes, stop
     after `early_stopping_rounds` non-improving rounds on the LAST metric
-    of the LAST eval set)."""
+    of the LAST eval set).
+
+    ``init_model``/``xgb_model`` (aliases; a Booster or a model path)
+    warm-start continuation: the new rounds APPEND to the existing
+    ensemble, and their iteration indices continue the existing round
+    numbering — so per-iteration seeding (``fold_in(seed, iteration)``,
+    subsample draws) matches what one uninterrupted run of
+    ``existing + num_boost_round`` rounds would have used, and the
+    continued model is bit-identical to it (the continuous-training
+    pipeline's resume contract, PIPELINE.md)."""
+    if init_model is not None and xgb_model is not None:
+        raise ValueError("pass init_model or xgb_model, not both "
+                         "(they are aliases)")
+    xgb_model = xgb_model if xgb_model is not None else init_model
+    start_round = 0
     if xgb_model is not None:
         bst = xgb_model if isinstance(xgb_model, Booster) else Booster(
             params, model_file=xgb_model)
         bst.set_param(params or {})
+        # continuation rounds keep counting where the loaded ensemble
+        # stopped (ntree accounting): round i of this call is global
+        # iteration start_round + i
+        if bst.gbtree is not None:
+            start_round = bst.gbtree.num_boosted_rounds
     else:
         bst = Booster(params, cache=[dtrain] + [d for d, _ in evals])
 
@@ -1659,13 +1704,13 @@ def train(params: dict, dtrain: DMatrix, num_boost_round: int = 10,
         # nothing runs on the host between rounds: fuse the whole round
         # loop into one device launch where eligible (update_many falls
         # back to per-round updates otherwise)
-        bst.update_many(dtrain, 0, num_boost_round, fobj=obj)
+        bst.update_many(dtrain, start_round, num_boost_round, fobj=obj)
         rounds = ()
     else:
         rounds = range(num_boost_round)
 
     for i in rounds:
-        bst.update(dtrain, i, fobj=obj)
+        bst.update(dtrain, start_round + i, fobj=obj)
         if not evals:
             continue
         from contextlib import nullcontext

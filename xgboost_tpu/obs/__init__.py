@@ -32,8 +32,9 @@ from xgboost_tpu.obs.events import (EventLog, configure_log,  # noqa: F401
 from xgboost_tpu.obs.metrics import (Counter, Gauge,  # noqa: F401
                                      Histogram, LabeledCounter,
                                      LabeledGauge, MetricsRegistry,
-                                     PredictMetrics, ReliabilityMetrics,
-                                     ServingMetrics, TrainingMetrics,
+                                     PipelineMetrics, PredictMetrics,
+                                     ReliabilityMetrics, ServingMetrics,
+                                     TrainingMetrics, pipeline_metrics,
                                      predict_metrics, registry,
                                      reliability_metrics,
                                      training_metrics)
@@ -64,6 +65,7 @@ __all__ = [
     "MetricsRegistry", "registry",
     "ServingMetrics", "ReliabilityMetrics", "TrainingMetrics",
     "PredictMetrics", "predict_metrics",
+    "PipelineMetrics", "pipeline_metrics",
     "reliability_metrics", "training_metrics",
     "RoundProfiler",
     "start_metrics_server", "get_metrics_server", "stop_metrics_server",

@@ -597,6 +597,87 @@ def featurestore_metrics() -> FeatureStoreMetrics:
     return _FEATURESTORE
 
 
+# ---------------------------------------------------------------- pipeline
+class PipelineMetrics:
+    """Continuous-training pipeline accounting (``xgbtpu_pipeline_*``,
+    PIPELINE.md): the train→gate→publish cycle loop's health at a
+    glance — cycles completed, gate verdicts, publish cost, trees
+    shipped, and how stale the incumbent the fleet serves is.  One
+    instance per process (:func:`pipeline_metrics`); rendered into
+    every /metrics body via the registry."""
+
+    def __init__(self, prefix: str = "xgbtpu_pipeline"):
+        p = prefix
+        self.cycles = Counter(
+            f"{p}_cycles_total", "train→gate→publish cycles completed "
+            "(any outcome: published, gate-failed, or idle)")
+        self.cycle_seconds = Histogram(
+            f"{p}_cycle_seconds", "wall time per pipeline cycle",
+            _ROUND_BUCKETS)
+        self.gate_pass = Counter(
+            f"{p}_gate_pass_total", "candidates that passed the eval gate")
+        self.gate_fail = Counter(
+            f"{p}_gate_fail_total",
+            "candidates rejected by the eval gate (incl. corrupt "
+            "candidates failing CRC verification)")
+        self.publishes = Counter(
+            f"{p}_publishes_total",
+            "gated models published to the serving path")
+        self.publish_failures = Counter(
+            f"{p}_publish_failures_total",
+            "publish attempts that failed (I/O error or a rejected "
+            "fleet canary rollout)")
+        self.publish_seconds = Counter(
+            f"{p}_publish_seconds_total",
+            "cumulative wall seconds spent publishing gated models")
+        self.trees_published = Counter(
+            f"{p}_trees_published_total",
+            "trees appended to the incumbent and published")
+        self.quarantines = Counter(
+            f"{p}_quarantines_total",
+            "candidates quarantined (failed gate or failed verification)")
+        self.resumes = Counter(
+            f"{p}_resumes_total",
+            "cycles resumed after a crash (checkpoint-ring mid-train "
+            "resume or a re-gate of an already-trained candidate)")
+        self.incumbent_age = Gauge(
+            f"{p}_incumbent_age_seconds",
+            "seconds since this pipeline last published (0 until the "
+            "first publish)")
+        self._published_at: Optional[float] = None
+        self._all = (self.cycles, self.cycle_seconds, self.gate_pass,
+                     self.gate_fail, self.publishes,
+                     self.publish_failures, self.publish_seconds,
+                     self.trees_published, self.quarantines,
+                     self.resumes, self.incumbent_age)
+        registry().register("pipeline", self.render)
+
+    def note_publish(self) -> None:
+        """Stamp the incumbent-age clock (monotonic — the gauge is a
+        DURATION, XGT006)."""
+        self._published_at = time.perf_counter()
+
+    def render(self) -> str:
+        if self._published_at is not None:
+            self.incumbent_age.set(time.perf_counter()
+                                   - self._published_at)
+        return "".join(m.render() for m in self._all)
+
+
+_PIPELINE: Optional[PipelineMetrics] = None
+_PIPELINE_LOCK = threading.Lock()
+
+
+def pipeline_metrics() -> PipelineMetrics:
+    """The process-wide PipelineMetrics singleton."""
+    global _PIPELINE
+    if _PIPELINE is None:
+        with _PIPELINE_LOCK:
+            if _PIPELINE is None:
+                _PIPELINE = PipelineMetrics()
+    return _PIPELINE
+
+
 # ------------------------------------------------------------------- fleet
 class FleetMetrics:
     """Router-side fleet accounting (``xgbtpu_fleet_*``, SERVING.md

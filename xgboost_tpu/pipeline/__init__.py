@@ -1,0 +1,74 @@
+"""xgboost_tpu.pipeline — continuous training next to a live fleet.
+
+The composition layer that turns five standalone subsystems into one
+story (PIPELINE.md): warm-start continuation (learner), the checkpoint
+ring + CRC'd atomic persistence (reliability), the hot-reload registry
+the serving tier polls (serving), the canary rollout lane (fleet), and
+per-phase spans/metrics (obs).  A :class:`ContinuousTrainer` loads the
+currently-published model, appends trees on fresh data from a
+pluggable :class:`DataSource`, judges the candidate against the
+incumbent on a held-out window (:class:`EvalGate`), and atomically
+publishes gated models to the path the serving fleet watches
+(:class:`Publisher` / :class:`RolloutPublisher`) — surviving
+kill/corrupt at every boundary.
+
+Quickstart::
+
+    python -m xgboost_tpu task=pipeline \\
+        pipeline_publish_path=serving/model.bin \\
+        pipeline_data=fresh-{cycle}.libsvm pipeline_holdout=holdout.libsvm \\
+        pipeline_rounds_per_cycle=5 pipeline_cycles=0 \\
+        objective=binary:logistic max_depth=4
+"""
+
+from typing import Optional
+
+from xgboost_tpu.pipeline.datasource import (CallableDataSource,  # noqa: F401
+                                             DataSource, FileDataSource,
+                                             SyntheticDataSource)
+from xgboost_tpu.pipeline.gate import EvalGate  # noqa: F401
+from xgboost_tpu.pipeline.publisher import (Publisher,  # noqa: F401
+                                            PublishRejected,
+                                            RolloutPublisher)
+from xgboost_tpu.pipeline.trainer import ContinuousTrainer  # noqa: F401
+
+
+def run_pipeline(publish_path: str, workdir: str = "./pipeline",
+                 data: str = "", holdout: str = "",
+                 rounds_per_cycle: int = 5, cycles: int = 1,
+                 metric: str = "", min_delta: float = 0.0,
+                 max_regression: float = 0.0, router_url: str = "",
+                 publish_timeout_sec: float = 600.0,
+                 sleep_sec: float = 0.0,
+                 params: Optional[dict] = None,
+                 source: Optional[DataSource] = None,
+                 quiet: bool = False) -> dict:
+    """Assemble the default pipeline from flat knob values (the CLI
+    ``task=pipeline`` surface — every ``PIPELINE_PARAMS`` key maps to
+    one argument) and run it.  ``source`` overrides the file seam for
+    embedders."""
+    if not publish_path:
+        raise ValueError("pipeline_publish_path is required")
+    if source is None:
+        if not data or not holdout:
+            raise ValueError(
+                "pipeline_data and pipeline_holdout are required "
+                "(or pass a custom DataSource)")
+        source = FileDataSource(data, holdout)
+    gate = EvalGate(metric=metric, min_delta=min_delta,
+                    max_regression=max_regression)
+    publisher = (RolloutPublisher(publish_path, router_url,
+                                  timeout=publish_timeout_sec)
+                 if router_url else Publisher(publish_path))
+    trainer = ContinuousTrainer(
+        publish_path, source, workdir,
+        rounds_per_cycle=rounds_per_cycle, params=params, gate=gate,
+        publisher=publisher, quiet=quiet)
+    return trainer.run(cycles=cycles, sleep_sec=sleep_sec)
+
+
+__all__ = [
+    "ContinuousTrainer", "DataSource", "FileDataSource",
+    "SyntheticDataSource", "CallableDataSource", "EvalGate",
+    "Publisher", "RolloutPublisher", "PublishRejected", "run_pipeline",
+]

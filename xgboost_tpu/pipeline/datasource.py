@@ -1,0 +1,127 @@
+"""Fresh-data seam for the continuous-training pipeline (PIPELINE.md).
+
+A :class:`DataSource` answers one question per cycle: *what does the
+trainer append trees on, and what does the gate judge on?*  The seam is
+deliberately tiny — ``next_cycle(cycle) -> (dtrain, dholdout) | None``
+— so production feeds (a directory a log-shipper drops files into, a
+feature-store export, a queue consumer) plug in without touching the
+trainer.
+
+Determinism contract: for a given ``cycle`` index the source must hand
+back the SAME data on every call — a cycle killed mid-train resumes
+from the checkpoint ring and re-reads its data, and the resumed run
+must be bit-identical to an uninterrupted one (the chaos harness
+asserts exactly this).  :class:`FileDataSource` satisfies it by
+re-reading the same files; :class:`SyntheticDataSource` by seeding its
+generator with ``fold(seed, cycle)``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Tuple
+
+
+class DataSource:
+    """Pluggable fresh-data feed.  ``next_cycle`` returns the cycle's
+    ``(train DMatrix, holdout DMatrix)`` pair, or ``None`` when no
+    fresh data is available yet (the trainer idles and retries)."""
+
+    def next_cycle(self, cycle: int):
+        raise NotImplementedError
+
+    def holdout_for(self, cycle: int):
+        """The holdout window ALONE, or None when unavailable.  The
+        crash-recovery re-gate needs no fresh train data — a producer
+        that rotated the cycle's train file away between the kill and
+        the restart must not wedge the re-gate forever.  Default:
+        the pair's second element."""
+        data = self.next_cycle(cycle)
+        return None if data is None else data[1]
+
+
+class FileDataSource(DataSource):
+    """Per-cycle file feed: ``train_path`` may carry a ``{cycle}``
+    placeholder (``fresh-{cycle}.libsvm``) that substitutes the cycle
+    index — the producer-drops-a-file-per-window idiom; without the
+    placeholder the same path is re-read every cycle (the producer
+    rewrites it in place, atomically).  ``holdout_path`` is the fixed
+    held-out eval window; it is re-loaded only when its (mtime, size)
+    changes, so a long-running pipeline does not re-parse an unchanged
+    holdout every cycle."""
+
+    def __init__(self, train_path: str, holdout_path: str,
+                 silent: bool = True):
+        self.train_path = train_path
+        self.holdout_path = holdout_path
+        self.silent = silent
+        self._holdout = None
+        self._holdout_stat = None
+
+    def _resolve(self, cycle: int) -> str:
+        return self.train_path.replace("{cycle}", str(cycle))
+
+    def _load_holdout(self):
+        st = os.stat(self.holdout_path)
+        stat = (st.st_mtime_ns, st.st_size)
+        if self._holdout is None or stat != self._holdout_stat:
+            from xgboost_tpu.data import DMatrix
+            self._holdout = DMatrix(self.holdout_path, silent=self.silent)
+            self._holdout_stat = stat
+        return self._holdout
+
+    def next_cycle(self, cycle: int):
+        path = self._resolve(cycle)
+        if not os.path.exists(path) or not os.path.exists(
+                self.holdout_path):
+            return None
+        from xgboost_tpu.data import DMatrix
+        return (DMatrix(path, silent=self.silent), self._load_holdout())
+
+    def holdout_for(self, cycle: int):
+        # independent of the cycle's train file: a re-gate after the
+        # producer rotated it away still has its holdout
+        if not os.path.exists(self.holdout_path):
+            return None
+        return self._load_holdout()
+
+
+class SyntheticDataSource(DataSource):
+    """Deterministic synthetic stream (bench + chaos + tests): cycle
+    ``k`` draws ``n_rows`` fresh rows from a generator seeded with
+    ``seed + k + 1`` against a fixed target function, and the holdout
+    is one fixed draw at ``seed``.  Same cycle index, same bytes —
+    the determinism contract the resume path needs, with zero files."""
+
+    def __init__(self, n_rows: int = 512, n_features: int = 8,
+                 seed: int = 0):
+        self.n_rows = int(n_rows)
+        self.n_features = int(n_features)
+        self.seed = int(seed)
+        self._holdout = None
+
+    def _draw(self, seed: int, n: int):
+        import numpy as np
+
+        from xgboost_tpu.data import DMatrix
+        rng = np.random.RandomState(seed)
+        X = rng.rand(n, self.n_features).astype(np.float32)
+        y = ((X[:, 0] + 0.25 * X[:, 1]) > 0.6).astype(np.float32)
+        return DMatrix(X, label=y)
+
+    def next_cycle(self, cycle: int):
+        if self._holdout is None:
+            self._holdout = self._draw(self.seed, max(self.n_rows, 256))
+        return (self._draw(self.seed + cycle + 1, self.n_rows),
+                self._holdout)
+
+
+class CallableDataSource(DataSource):
+    """Wrap a plain ``cycle -> (dtrain, dholdout) | None`` function
+    (tests, notebooks)."""
+
+    def __init__(self, fn: Callable[[int], Optional[Tuple]]):
+        self.fn = fn
+
+    def next_cycle(self, cycle: int):
+        return self.fn(cycle)

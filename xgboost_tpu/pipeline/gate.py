@@ -1,0 +1,78 @@
+"""Eval gate: candidate-vs-incumbent scoring on a held-out window.
+
+The publish decision of the continuous-training pipeline (PIPELINE.md):
+both models score the SAME holdout DMatrix through the learner's own
+eval path (``Booster.eval_set`` — the gate sees exactly what a
+training eval line would print, transform quirks included), and the
+candidate publishes only when its improvement clears the threshold.
+
+Threshold semantics (one number, two knobs):
+
+- ``min_delta > 0`` demands strict improvement: the candidate must beat
+  the incumbent by at least ``min_delta`` (``max_regression`` is moot).
+- otherwise ``max_regression`` is the tolerated worsening: fresh-data
+  drift can make an honest candidate score slightly worse on a fixed
+  holdout, and a pipeline that never publishes is as broken as one
+  that publishes garbage.  Defaults (0, 0) mean "no worse than the
+  incumbent".
+
+A missing incumbent (cold start — nothing at the publish path yet)
+passes unconditionally: there is nothing to regress against.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from xgboost_tpu.learner import _MAXIMIZE_METRICS, _parse_eval
+
+
+class EvalGate:
+    """Judge a candidate model against the incumbent on a holdout."""
+
+    def __init__(self, metric: str = "", min_delta: float = 0.0,
+                 max_regression: float = 0.0):
+        self.metric = metric
+        self.min_delta = float(min_delta)
+        self.max_regression = float(max_regression)
+
+    def _score(self, bst, holdout, cycle: int) -> tuple:
+        """-> (metric_name, value) via the learner's eval path."""
+        if self.metric:
+            bst.param.eval_metric = (self.metric,)
+        scores = _parse_eval(bst.eval_set([(holdout, "gate")], cycle))
+        key = list(scores)[-1]
+        return key.split("-", 1)[1], scores[key]
+
+    def judge(self, candidate, incumbent: Optional[object], holdout,
+              cycle: int = 0,
+              incumbent_score: Optional[float] = None) -> dict:
+        """-> verdict dict: ``passed``, ``metric``, ``candidate``,
+        ``incumbent``, ``improvement`` (signed so positive = better),
+        ``threshold``, ``reason``.
+
+        ``incumbent_score`` (when not None) is a precomputed incumbent
+        value on THIS holdout under THIS gate config — the trainer's
+        per-hash cache; the incumbent model then never loads or
+        scores."""
+        name, c = self._score(candidate, holdout, cycle)
+        if incumbent is None and incumbent_score is None:
+            return {"passed": True, "metric": name, "candidate": c,
+                    "incumbent": None, "improvement": None,
+                    "reason": "no incumbent (cold start)"}
+        i = (incumbent_score if incumbent_score is not None
+             else self._score(incumbent, holdout, cycle)[1])
+        maximize = any(name.startswith(m) for m in _MAXIMIZE_METRICS)
+        improvement = (c - i) if maximize else (i - c)
+        threshold = (self.min_delta if self.min_delta > 0.0
+                     else -self.max_regression)
+        passed = improvement >= threshold
+        verdict = {"passed": passed, "metric": name,
+                   "candidate": c, "incumbent": i,
+                   "improvement": improvement, "threshold": threshold}
+        if not passed:
+            verdict["reason"] = (
+                f"{name} improvement {improvement:.6f} < "
+                f"threshold {threshold:.6f} "
+                f"(candidate {c:.6f} vs incumbent {i:.6f})")
+        return verdict
