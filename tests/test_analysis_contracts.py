@@ -1,4 +1,4 @@
-"""xgtpu-lint v2: whole-repo contract rules XGT008-XGT011
+"""xgtpu-lint v2: whole-repo contract rules XGT008-XGT012
 (ANALYSIS.md §v2, analysis/contracts.py).
 
 Layers:
@@ -272,6 +272,56 @@ class TestKnobDrift:
                    and f.rule == "XGT010" for f in act), messages(act)
 
 
+# ------------------------------------------------------------------ XGT012
+class TestHTTPTimeoutDiscipline:
+    def test_timeoutless_urlopen_fires(self, tmp_path):
+        (tmp_path / "c.py").write_text(
+            'import urllib.request\n'
+            'def go(url):\n'
+            '    return urllib.request.urlopen(url)\n')
+        act, _ = run_codes(tmp_path, {"XGT012"})
+        assert len(act) == 1 and "urlopen" in act[0].message
+        assert "timeout" in act[0].message
+
+    def test_timeoutless_connection_fires(self, tmp_path):
+        (tmp_path / "c.py").write_text(
+            'import http.client\n'
+            'def go(host, port):\n'
+            '    return http.client.HTTPConnection(host, port)\n')
+        act, _ = run_codes(tmp_path, {"XGT012"})
+        assert len(act) == 1 and "HTTPConnection" in act[0].message
+
+    def test_explicit_timeouts_are_clean(self, tmp_path):
+        (tmp_path / "c.py").write_text(
+            'import http.client, urllib.request\n'
+            'def go(host, port, url, req):\n'
+            '    http.client.HTTPConnection(host, port, timeout=3.0)\n'
+            '    http.client.HTTPConnection(host, port, 5.0)\n'
+            '    urllib.request.urlopen(url, timeout=2.0)\n'
+            '    urllib.request.urlopen(req, None, 2.0)\n')
+        act, _ = run_codes(tmp_path, {"XGT012"})
+        assert not act, messages(act)
+
+    def test_inline_suppression_silences(self, tmp_path):
+        (tmp_path / "c.py").write_text(
+            'import urllib.request\n'
+            'def go(url):\n'
+            '    return urllib.request.urlopen(url)'
+            '  # xgtpu: disable=XGT012\n')
+        act, sup = run_codes(tmp_path, {"XGT012"})
+        assert not act and len(sup) == 1
+
+    def test_repo_has_no_timeoutless_client(self):
+        """Acceptance: the XGT012 debt is zero — every outbound HTTP
+        call in the package + tools passes an explicit timeout (the
+        baseline stays empty)."""
+        facts = default_engine([PKG_DIR]).facts()
+        assert facts.http_calls, "no HTTP client facts extracted"
+        missing = [(f, c, ln) for f, c, ln, ht in facts.http_calls
+                   if not ht]
+        assert not missing, missing
+
+
 # ------------------------------------------------------------------ XGT011
 def lock_tree(order_m2: str) -> str:
     return (
@@ -377,9 +427,12 @@ class TestInventory:
     def _mini_tree(self, tmp_path):
         (tmp_path / "server.py").write_text(SERVER_SRC)
         (tmp_path / "m.py").write_text(
-            'import os, threading\n'
+            'import os, threading, urllib.request\n'
             'c = Counter("xgbtpu_foo_total", "h")\n'
             'v = os.environ.get("XGBTPU_FIX_KNOB")\n'
+            'def probe(url):\n'
+            '    return urllib.request.urlopen(url + "/healthz",\n'
+            '                                  timeout=2.0)\n'
             'class A:\n'
             '    def m(self):\n'
             '        with self._a_lock:\n'
@@ -407,7 +460,8 @@ class TestInventory:
 
     @pytest.mark.parametrize("section,rule", [
         ("http_routes", "XGT008"), ("metric_families", "XGT009"),
-        ("env_knobs", "XGT010"), ("lock_edges", "XGT011")])
+        ("env_knobs", "XGT010"), ("lock_edges", "XGT011"),
+        ("http_clients", "XGT012")])
     def test_drift_detection_per_section(self, tmp_path, section, rule):
         self._mini_tree(tmp_path)
         eng = engine_for(tmp_path)
@@ -440,6 +494,9 @@ class TestInventory:
         assert committed["lock_edges"]
         assert committed["cli_params"]["serve"]
         assert committed["cli_params"]["fleet"]
+        assert committed["http_clients"]
+        # the committed proof the tree has no timeout-less client
+        assert all(e["timeout"] for e in committed["http_clients"])
 
 
 # ---------------------------------------------------------- enforcement

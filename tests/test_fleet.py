@@ -525,6 +525,179 @@ def test_canary_rollout_rolls_back_when_canary_killed(models):
         rt.shutdown()
 
 
+# ----------------------------------------------- latency-aware ejection
+def test_latency_ejection_routes_around_wedged_replica():
+    """ISSUE 10 tentpole (3): a slow-but-alive replica — every request
+    SUCCEEDS, so the failure-count breaker never sees it — is ejected
+    on its latency EWMA (k × fleet median), traffic routes around it
+    with zero failures, and a fast post-cooldown probe readmits it."""
+    rt = FleetRouter(port=0, hc_sec=0, slow_eject_factor=3.0,
+                     slow_eject_cooldown_sec=0.8, quiet=True).start()
+    base = f"http://{rt.host}:{rt.port}"
+    slow, f1, f2 = _Stub(), _Stub(), _Stub()
+    slow.delay = 0.12  # wedged-but-alive: answers, late
+    try:
+        # "a-slow" sorts first, so least-loaded ties prefer the wedge
+        _register_stub(base, "a-slow", slow)
+        _register_stub(base, "b-fast", f1)
+        _register_stub(base, "c-fast", f2)
+        codes = []
+        lock = threading.Lock()
+
+        def fire(n):
+            for _ in range(n):
+                st, _, _, _ = _post(base + "/predict", data=b"0.5")
+                with lock:
+                    codes.append(st)
+
+        # concurrent traffic until the ejection fires (scheduling on a
+        # loaded 1-core host decides how fast the wedge accumulates
+        # its EWMA samples; the CONTRACT is that it ejects, not when)
+        members = {}
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            ts = [threading.Thread(target=fire, args=(6,))
+                  for _ in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            members = {m["replica_id"]: m for m in
+                       _get(base + "/fleet/members")["replicas"]}
+            if members["a-slow"]["ejected"]:
+                break
+        assert set(codes) == {200}, "a slow replica cost client errors"
+        assert members["a-slow"]["ejected"] is True
+        # the breaker never saw it — this is the DISTINCT state
+        assert members["a-slow"]["breaker"] == "closed"
+        assert members["a-slow"]["latency_ewma_ms"] > 50
+        assert members["b-fast"]["ejected"] is False
+        samples = scrape_samples(urllib.request.urlopen(
+            base + "/metrics", timeout=10).read().decode())
+        assert samples["xgbtpu_fleet_slow_ejections_total"] >= 1
+        # while ejected the wedge takes no REGULAR traffic — at most
+        # one readmission probe may slip in if the burst already aged
+        # past the cooldown (the probe is ejection working as designed,
+        # not a dispatch leak)
+        hits_at_eject = slow.hits
+        fire(8)
+        assert slow.hits <= hits_at_eject + 1, \
+            "ejected replica kept receiving regular dispatches"
+        # heal it; after the cooldown ONE probe decides readmission
+        slow.delay = 0.0
+        time.sleep(0.9)
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            fire(4)
+            members = {m["replica_id"]: m for m in
+                       _get(base + "/fleet/members")["replicas"]}
+            if not members["a-slow"]["ejected"]:
+                break
+        assert members["a-slow"]["ejected"] is False, \
+            "healed replica never readmitted"
+        assert slow.hits > hits_at_eject
+        assert set(codes) == {200}
+    finally:
+        slow.close()
+        f1.close()
+        f2.close()
+        rt.shutdown()
+
+
+def test_ejection_state_machine_edge_cases():
+    """Direct-state checks on the ejection machinery: (a) lease-dead
+    ghosts are excluded from the peer-median comparator; (b) a recover
+    re-registration resets ejection state like it resets the breaker;
+    (c) only the dispatch that was GRANTED the readmission probe can
+    resolve it (thread-token attribution)."""
+    import time as _t
+
+    from xgboost_tpu.fleet.membership import Membership
+    m = Membership(lease_sec=30.0, slow_eject_factor=3.0)
+    for rid in ("r1", "r2", "r3"):
+        m.register(rid, f"http://x/{rid}")
+    r1, r2, r3 = (m.get(r) for r in ("r1", "r2", "r3"))
+    # (a) ghost exclusion: r3 dies without deregistering, carrying a
+    # huge wedged-era EWMA — the live pair's comparator must not see it
+    r1.lat_ewma, r1.lat_samples = 0.002, 20
+    r2.lat_ewma, r2.lat_samples = 0.002, 20
+    r3.lat_ewma, r3.lat_samples = 0.600, 20
+    r3.lease_deadline = _t.monotonic() - 1.0  # lease-dead ghost
+    with m._lock:
+        assert m._peer_median_lat_locked(r1) == pytest.approx(0.002)
+    # (b) recover reset: an ejected, wedged-history replica restarts
+    # and re-registers under its old id — clean slate
+    r2.ejected = True
+    r2.ejected_at = _t.monotonic()
+    r2.lat_ewma, r2.lat_samples = 0.600, 50
+    assert m.register("r2", "http://x/r2b")["recovered"] is True
+    r2 = m.get("r2")
+    assert r2.ejected is False and r2.lat_samples == 0
+    assert r2.lat_ewma == 0.0
+    # (c) probe attribution: grant the probe on this thread, then a
+    # release from ANOTHER thread (a fast entity-id hop) must neither
+    # resolve the probe nor readmit the replica.  (r2 gets fresh fast
+    # samples first: with no live sampled peer there is no comparator
+    # and a successful probe readmits unconditionally.)
+    r2.lat_ewma, r2.lat_samples = 0.002, 20
+    r1.ejected = True
+    r1.ejected_at = _t.monotonic() - 60.0  # cooldown long past
+    rep = m.acquire()  # grants r1's readmission probe to THIS thread
+    assert rep is r1 and r1.eject_probe_inflight
+    other = m.acquire_specific("r1")  # entity-id hop, not gated
+    assert other is r1
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(m.release(r1, ok=True, latency=0.001)))
+    t.start()
+    t.join()
+    assert r1.ejected is True, "foreign release resolved the probe"
+    assert r1.eject_probe_inflight, "foreign release freed the slot"
+    # the GRANTED thread's slow probe outcome keeps it ejected
+    m.release(r1, ok=True, latency=0.5)
+    assert r1.ejected is True and not r1.eject_probe_inflight
+
+
+def test_slow_replica_fault_kind_delays_predicts(models):
+    """The `slow_replica` chaos kind (reliability/faults.py) wedges a
+    real replica's predict path — keyed on its fleet replica id, lease
+    and health untouched — so the ejection machinery is chaos-testable
+    end to end (tools/chaos_loop.py --fleet --slow)."""
+    from xgboost_tpu.reliability import faults
+    _, _, X, pa, _ = models
+    rt = FleetRouter(port=0, hc_sec=0.3, quiet=True).start()
+    base = f"http://{rt.host}:{rt.port}"
+    srv = _replica(pa, base, "r-wedge")
+    try:
+        assert _get(base + "/fleet/members")["in_rotation"] == 1
+        body = _csv(X[:2])
+        # warm the replica first: the baseline must not include the
+        # cold-start bucket compile (which alone can exceed the wedge)
+        for _ in range(3):
+            st, _, _, _ = _post(base + "/predict", data=body)
+            assert st == 200
+        t0 = time.perf_counter()
+        st, _, _, _ = _post(base + "/predict", data=body)
+        assert st == 200
+        fast = time.perf_counter() - t0
+        assert fast < 0.4, f"warm baseline too slow ({fast:.2f}s)"
+        faults.inject("slow_replica", 0.4, path_sub="r-wedge", times=1)
+        t0 = time.perf_counter()
+        st, _, _, _ = _post(base + "/predict", data=body)
+        wedged = time.perf_counter() - t0
+        assert st == 200, "the wedge must delay, never fail"
+        assert wedged >= 0.4 > fast
+        # the fault disarmed after `times`: back to fast
+        t0 = time.perf_counter()
+        st, _, _, _ = _post(base + "/predict", data=body)
+        assert st == 200
+        assert time.perf_counter() - t0 < 0.4
+    finally:
+        faults.clear_faults()
+        srv.shutdown()
+        rt.shutdown()
+
+
 # ----------------------------------------------------------- load shed
 def test_router_inflight_budget_sheds_503():
     """(f) admission control: concurrent requests past the global
@@ -556,9 +729,20 @@ def test_router_inflight_budget_sheds_503():
         for st, js in results:
             if st == 503:
                 assert js.get("shed") is True
-        shed = scrape_samples(urllib.request.urlopen(
-            base + "/metrics").read().decode())
+        def scrape():
+            return scrape_samples(urllib.request.urlopen(
+                base + "/metrics", timeout=10).read().decode())
+
+        shed = scrape()
         assert shed["xgbtpu_fleet_shed_total"] == codes.count(503)
+        # settle poll: a handler thread sends its response BEFORE its
+        # finally-block exit_request runs, so the gauge can trail the
+        # client's join by a scheduling quantum
+        deadline = time.perf_counter() + 5.0
+        while (shed["xgbtpu_fleet_inflight"]
+               and time.perf_counter() < deadline):
+            time.sleep(0.05)
+            shed = scrape()
         assert shed["xgbtpu_fleet_inflight"] == 0
     finally:
         stub.close()

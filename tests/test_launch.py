@@ -125,8 +125,110 @@ def test_launcher_keepalive_restarts(tmp_path):
         "    sys.exit(3)\n"
         "print('survived trial', os.environ['XGBTPU_NUM_TRIAL'])\n")
     from xgboost_tpu.parallel.launch import launch_local
-    rc = launch_local(1, [sys.executable, str(script)], keepalive=True)
+    rc = launch_local(1, [sys.executable, str(script)], keepalive=True,
+                      restart_backoff_sec=0.05)
     assert rc == 0
+
+
+# a worker that heartbeats once, then wedges forever on trial 0 and
+# exits clean on any later trial — the launcher-watchdog test double
+# (mesh-free: no jax anywhere)
+_STALL_SCRIPT = """\
+import os, sys, time
+trial = int(os.environ.get("XGBTPU_NUM_TRIAL", "0"))
+hb = os.environ.get("XGBTPU_HEARTBEAT_DIR")
+rank = os.environ.get("XGBTPU_WORKER_ID", "0")
+if hb:
+    with open(os.path.join(hb, f"hb-{rank}"), "w") as f:
+        f.write("0")
+if trial == 0:
+    time.sleep(600)  # wedged: no further heartbeats
+sys.exit(0)
+"""
+
+
+def test_watchdog_kills_and_restarts_stalled_gang(tmp_path, capfd):
+    """ISSUE 10 tentpole (2): a gang that stops advancing (heartbeats
+    stale for watchdog_stall_sec) is killed and restarted on a bumped
+    trial — stall-detection keepalive, the allreduce_robust timeout
+    analog.  Counter- and event-verified."""
+    from xgboost_tpu.parallel.launch import launch_local
+    from xgboost_tpu.profiling import reliability_metrics
+    script = tmp_path / "staller.py"
+    script.write_text(_STALL_SCRIPT)
+    rm = reliability_metrics()
+    base_stall = rm.launch_restarts.value("stall")
+    rc = launch_local(1, [sys.executable, str(script)], keepalive=True,
+                      watchdog_stall_sec=1.2, restart_backoff_sec=0.05,
+                      standalone=True)
+    assert rc == 0
+    assert rm.launch_restarts.value("stall") == base_stall + 1
+    err = capfd.readouterr().err
+    assert "[launch] STALL" in err
+    assert "restarting all 1 workers, trial 1 (reason stall" in err
+
+
+def test_watchdog_no_keepalive_kills_and_returns_stall_rc(tmp_path):
+    """Without keepalive the watchdog still UNWEDGES the job — the
+    gang is killed and the distinct stall exit code surfaces."""
+    from xgboost_tpu.parallel.launch import STALL_RC, launch_local
+    script = tmp_path / "staller.py"
+    script.write_text(_STALL_SCRIPT)
+    rc = launch_local(1, [sys.executable, str(script)], keepalive=False,
+                      watchdog_stall_sec=1.0, standalone=True)
+    assert rc == STALL_RC
+
+
+def test_stall_mock_watchdog_resume_bit_identical_composed_with_death(
+        tmp_path):
+    """Satellite: the `stall` mock kind composed with death, end to end
+    through the REAL CLI (the local_recover.cc analog for hangs,
+    mesh-free via --standalone): the worker wedges at (version 2,
+    seqno 0, trial 0), the watchdog kills+restarts the gang, the
+    restarted trial dies at (version 3, trial 1), keepalive restarts
+    again, and the final model is BIT-identical to an uninterrupted
+    run."""
+    data = tmp_path / "train.libsvm"
+    rng = np.random.RandomState(5)
+    X = rng.rand(300, 5)
+    y = (X[:, 0] > 0.5).astype(int)
+    with open(data, "w") as fh:
+        for i in range(300):
+            feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(5))
+            fh.write(f"{y[i]} {feats}\n")
+    common = [f"data={data}", "task=train", "num_round=4", "silent=2",
+              "objective=binary:logistic", "max_depth=3", "eta=0.5",
+              "max_bin=16"]
+    ref = tmp_path / "ref.model"
+    chaos = tmp_path / "chaos.model"
+    env = _clean_env()
+    r = subprocess.run(
+        [sys.executable, "-m", "xgboost_tpu", *common,
+         f"model_out={ref}", f"checkpoint_dir={tmp_path / 'ck_ref'}"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-3000:]
+    r = subprocess.run(
+        [sys.executable, "-m", "xgboost_tpu.launch", "-n", "1",
+         "--standalone", "--keepalive", "--watchdog-stall-sec", "4",
+         "--restart-backoff-sec", "0.2", "--",
+         sys.executable, "-m", "xgboost_tpu", *common,
+         f"model_out={chaos}", f"checkpoint_dir={tmp_path / 'ck'}",
+         "mock=stall:2,0,0;die:3,0,1"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "[mock] stall at version=2" in r.stderr
+    assert "[launch] STALL" in r.stderr
+    assert "reason stall" in r.stderr
+    assert "die at version=3" in r.stderr
+    assert "reason death" in r.stderr
+    assert "[ckpt] resume at round 2" in r.stderr
+
+    import xgboost_tpu as xgb
+    a = xgb.Booster(model_file=str(ref)).gbtree.get_state()
+    b = xgb.Booster(model_file=str(chaos)).gbtree.get_state()
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
 
 
 def test_two_process_full_booster_training(tmp_path):

@@ -44,6 +44,15 @@ from launch_fleet import FleetLauncher, RetryingPredictClient  # noqa: E402
 N_TRAIN, N_FEAT, ROUNDS = 20_000, 28, 20
 CLIENTS = int(os.environ.get("BENCH_FLEET_CLIENTS", "16"))
 REQS = int(os.environ.get("BENCH_FLEET_REQS", "1500"))
+# deadline cells: the end-to-end budgets stamped on every request.
+# FEASIBLE sits above the loaded p50 (most requests can finish; the
+# tail shows the late/rejected split), TIGHT sits below it (the
+# overload case the discipline exists for: the win is rejected-early
+# ≫ completed-late — the fleet stops paying for answers nobody reads)
+DEADLINE_FEASIBLE_MS = float(
+    os.environ.get("BENCH_FLEET_DEADLINE_MS", "25"))
+DEADLINE_TIGHT_MS = float(
+    os.environ.get("BENCH_FLEET_DEADLINE_TIGHT_MS", "12"))
 SERVE_ARGS = ["serve_min_bucket=8", "serve_max_bucket=64",
               "serve_max_wait_ms=1.0"]
 
@@ -66,32 +75,50 @@ def _bodies(n: int = 64):
             for _ in range(n)]
 
 
-def hammer(base_url: str, total_reqs: int, clients: int):
+def hammer(base_url: str, total_reqs: int, clients: int,
+           deadline_ms=None):
     """``clients`` threads, keep-alive connections, 1-row posts
     (retry-once semantics live in launch_fleet.RetryingPredictClient).
-    Returns aggregate stats + per-request outcome counts."""
+    Returns aggregate stats + per-request outcome counts.
+
+    ``deadline_ms`` stamps every request with that ``X-Deadline-Ms``
+    budget and splits the outcome accounting into completed-in-budget /
+    completed-late / rejected-up-front (504): the deadline cell's
+    claim is that under a tight budget, rejected-early ≫
+    completed-late — the fleet stops paying for answers nobody reads."""
     bodies = _bodies()
     per_client = total_reqs // clients
     lat: list = []
-    counts = {"ok": 0, "shed": 0, "fail": 0}
+    counts = {"ok": 0, "shed": 0, "fail": 0,
+              "in_budget": 0, "late": 0, "rejected_early": 0}
+    headers = ({"X-Deadline-Ms": str(deadline_ms)}
+               if deadline_ms is not None else None)
     fail_details: list = []
     lock = threading.Lock()
     barrier = threading.Barrier(clients + 1)
 
     def client(ci: int):
         conn = RetryingPredictClient(base_url)
-        mine = {"ok": 0, "shed": 0, "fail": 0}
+        mine = dict.fromkeys(counts, 0)
         mylat = []
         details = []
         barrier.wait()
         for i in range(per_client):
             t0 = time.perf_counter()
-            status, detail = conn.post(bodies[(ci + i) % len(bodies)])
+            status, detail = conn.post(bodies[(ci + i) % len(bodies)],
+                                       headers=headers)
+            wall = time.perf_counter() - t0
             if status == 200:
                 mine["ok"] += 1
-                mylat.append(time.perf_counter() - t0)
+                mylat.append(wall)
+                if deadline_ms is not None:
+                    key = ("in_budget" if wall * 1e3 <= deadline_ms
+                           else "late")
+                    mine[key] += 1
             elif status == 503:
                 mine["shed"] += 1
+            elif status == 504 and deadline_ms is not None:
+                mine["rejected_early"] += 1
             else:
                 mine["fail"] += 1
                 details.append(detail if status is None
@@ -125,13 +152,64 @@ def hammer(base_url: str, total_reqs: int, clients: int):
         "failures": counts["fail"],
         "shed_rate": round(counts["shed"] / max(done, 1), 4),
     }
+    if deadline_ms is not None:
+        cell.update({
+            "deadline_ms": deadline_ms,
+            "completed_in_budget": counts["in_budget"],
+            "completed_late": counts["late"],
+            "rejected_early": counts["rejected_early"],
+            "in_budget_rate": round(counts["in_budget"] / max(done, 1), 4),
+            "rejected_early_vs_late": (
+                round(counts["rejected_early"] / counts["late"], 2)
+                if counts["late"] else counts["rejected_early"]),
+        })
     if fail_details:
         cell["failure_detail"] = fail_details[:5]
     return cell
 
 
+def _bench_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_fleet.json")
+
+
+def deadline_only() -> int:
+    """Run ONLY the deadline cell against a fresh 3-replica fleet and
+    merge it into the committed BENCH_fleet.json (the other cells'
+    numbers — measured under their own settings — stay untouched)."""
+    import tempfile
+    work = tempfile.mkdtemp(prefix="xgbtpu_benchdl_")
+    model = os.path.join(work, "model.bin")
+    print("[bench_fleet] training model...", file=sys.stderr)
+    _train_model(model)
+    fl = FleetLauncher(model, replicas=3,
+                       workdir=os.path.join(work, "f3"),
+                       serve_args=SERVE_ARGS, quiet=True)
+    fl.start()
+    fl.wait_ready()
+    hammer(fl.url, min(REQS, 400), CLIENTS)  # warm the service EWMAs
+    feasible = hammer(fl.url, REQS, CLIENTS,
+                      deadline_ms=DEADLINE_FEASIBLE_MS)
+    tight = hammer(fl.url, REQS, CLIENTS, deadline_ms=DEADLINE_TIGHT_MS)
+    fl.stop()
+    try:
+        with open(_bench_path()) as f:
+            out = json.load(f)
+    except OSError:
+        out = {}
+    out["deadline_feasible"] = feasible
+    out["deadline"] = tight
+    with open(_bench_path(), "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"deadline_feasible": feasible, "deadline": tight}))
+    return 0 if feasible["failures"] + tight["failures"] == 0 else 1
+
+
 def main():
     import tempfile
+    if "--deadline-only" in sys.argv[1:]:
+        return deadline_only()
     work = tempfile.mkdtemp(prefix="xgbtpu_benchfleet_")
     model = os.path.join(work, "model.bin")
     print("[bench_fleet] training model...", file=sys.stderr)
@@ -165,6 +243,16 @@ def main():
     fl.router.inflight_budget = 4
     out["overload"] = hammer(fl.url, REQS, CLIENTS)
     out["overload"]["inflight_budget"] = 4
+    # deadline: full admission again, but every request carries an
+    # X-Deadline-Ms budget — feasible first, then the tight overload
+    # case where the win is rejected-early ≫ completed-late
+    # (reliability/deadline.py; 504s are the deadline discipline
+    # working, not failures)
+    fl.router.inflight_budget = 256
+    out["deadline_feasible"] = hammer(fl.url, REQS, CLIENTS,
+                                      deadline_ms=DEADLINE_FEASIBLE_MS)
+    out["deadline"] = hammer(fl.url, REQS, CLIENTS,
+                             deadline_ms=DEADLINE_TIGHT_MS)
     fl.stop()
 
     out["value"] = out["router_3"]["requests_per_sec"]

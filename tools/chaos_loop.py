@@ -40,6 +40,23 @@ serves must be the initial seed model or a hash recorded in the
 pipeline's fsync'd ``gated.log`` ledger BEFORE its publish began.
 Emits ``PIPELINE_CHAOS.json``.
 
+``--train`` switches to the STALL-failure training mode (RELIABILITY.md
+stall matrix): each run arms a ``stall`` mock coordinate (the hang twin
+of worker death, parallel/mock.py) — and, half the time, a death
+coordinate on the NEXT trial — against the real CLI supervised by the
+gang launcher's heartbeat watchdog (``--watchdog-stall-sec``).  The
+wedged worker stops touching its per-rank heartbeat file, the watchdog
+kills and restarts the gang, the restarted trial sails past the
+coordinate (ntrial semantics) and resumes from the checkpoint ring; the
+assertion is the same bit-identical-final-model contract as the death
+suite.  Emits ``TRAIN_CHAOS.json``.
+
+``--fleet --slow`` arms ``slow_replica`` (a wedged-but-alive replica:
+every predict sleeps, lease and /healthz stay green) instead of kills:
+the router's latency-aware ejection must take the replica out of
+rotation and traffic must keep flowing with ZERO non-shed failures.
+Emits ``CHAOS_fleet_slow.json``.
+
 Also runs as a slow-marked test
 (tests/test_reliability.py::test_chaos_loop_driver).
 """
@@ -80,10 +97,101 @@ def _states_equal(a, b) -> bool:
     return all(np.array_equal(a[k], b[k]) for k in a)
 
 
+def train_stall_mode(args) -> int:
+    """Stall-failure training chaos: wedge the worker at a random
+    collective coordinate, let the watchdog kill+restart the gang, and
+    assert bit-identical resume — composed with a death on the restart
+    trial half the time (see module docstring)."""
+    import subprocess
+
+    from xgboost_tpu.cli import main as cli_main
+
+    work = args.workdir or tempfile.mkdtemp(prefix="xgbtpu_chaostrain_")
+    os.makedirs(work, exist_ok=True)
+    data = os.path.join(work, "train.libsvm")
+    _write_libsvm(data, seed=args.seed)
+    common = [f"data={data}", "task=train", f"num_round={args.rounds}",
+              "silent=2", "objective=binary:logistic", "max_depth=3",
+              "eta=0.5", "max_bin=16"]
+
+    # uninterrupted reference (checkpointing ON: identical code path)
+    ref_model = os.path.join(work, "ref.model")
+    rc = cli_main(common + [f"model_out={ref_model}",
+                            f"checkpoint_dir={os.path.join(work, 'ck_ref')}"])
+    if rc != 0:
+        print(f"reference run failed (rc={rc})", file=sys.stderr)
+        return 1
+    ref = _state(ref_model)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rng = np.random.RandomState(args.seed)
+    report = {"mode": "train_stall", "runs": args.runs,
+              "stalls_armed": 0, "deaths_armed": 0,
+              "watchdog_kills": 0, "restarts": 0,
+              "bit_identical": 0, "mismatches": 0, "run_log": []}
+    for run in range(args.runs):
+        out = os.path.join(work, f"m_{run:03d}.model")
+        vs = int(rng.randint(1, args.rounds))  # stall round (trial 0)
+        mock = f"stall:{vs},0,0"
+        report["stalls_armed"] += 1
+        entry = {"run": run, "mock": mock}
+        if rng.rand() < 0.5:
+            # compose stall with DEATH: the restarted trial (1) dies at
+            # a later coordinate, exercising watchdog-kill followed by
+            # plain keepalive restart in one recovery chain
+            vd = int(rng.randint(1, args.rounds))
+            mock += f";die:{vd},0,1"
+            entry["mock"] = mock
+            report["deaths_armed"] += 1
+        cmd = [sys.executable, "-m", "xgboost_tpu.launch", "-n", "1",
+               "--standalone", "--keepalive",
+               "--watchdog-stall-sec", str(args.stall_window),
+               "--restart-backoff-sec", "0.2", "--",
+               sys.executable, "-m", "xgboost_tpu", *common,
+               f"model_out={out}",
+               f"checkpoint_dir={os.path.join(work, f'ck_{run:03d}')}",
+               f"mock={mock}"]
+        r = subprocess.run(cmd, cwd=repo, capture_output=True,
+                           text=True, timeout=600,
+                           env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        entry["rc"] = r.returncode
+        entry["watchdog_kills"] = r.stderr.count("[launch] STALL")
+        entry["restarts"] = r.stderr.count("[launch] restarting")
+        report["watchdog_kills"] += entry["watchdog_kills"]
+        report["restarts"] += entry["restarts"]
+        if r.returncode == 0 and _states_equal(ref, _state(out)):
+            report["bit_identical"] += 1
+            entry["result"] = "bit_identical"
+        else:
+            report["mismatches"] += 1
+            entry["result"] = (f"rc={r.returncode}" if r.returncode
+                               else "MISMATCH")
+            entry["stderr_tail"] = r.stderr[-1500:]
+        report["run_log"].append(entry)
+        print(f"[chaos-train] run {run}: mock={mock} -> "
+              f"{entry['result']} ({entry['watchdog_kills']} watchdog "
+              f"kill(s), {entry['restarts']} restart(s))",
+              file=sys.stderr)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"[chaos-train] {report['bit_identical']}/{args.runs} "
+          f"bit-identical across {report['watchdog_kills']} watchdog "
+          f"kills / {report['restarts']} restarts -> {args.out}",
+          file=sys.stderr)
+    ok = (report["mismatches"] == 0 and report["watchdog_kills"] >= 1
+          and report["restarts"] >= report["watchdog_kills"])
+    return 0 if ok else 1
+
+
 def fleet_mode(args) -> int:
     """Replica-kill chaos against a live local fleet: random SIGKILLs
     mid-traffic + keepalive restarts; asserts zero non-shed request
-    failures (the router retry contract)."""
+    failures (the router retry contract).  With ``--slow``, the chaos
+    is a ``slow_replica`` wedge instead of kills: one replica stays
+    alive and healthy-looking but answers every predict late, and the
+    router's latency-aware ejection must route around it — same
+    zero-non-shed-failures contract, plus at least one ejection."""
     import threading
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -102,6 +210,14 @@ def fleet_mode(args) -> int:
     model = os.path.join(work, "model.bin")
     bst.save_model(model)
 
+    wedged = args.fleet_replicas - 1  # highest-numbered replica
+    replica_faults = None
+    if args.slow:
+        # arm the wedge in the replica subprocess's env: every predict
+        # on r<wedged> sleeps, while lease + /healthz stay green —
+        # invisible to the breaker, fatal to the fleet p99
+        replica_faults = {wedged: f"slow_replica={args.slow_delay}"
+                                  f"@r{wedged}*1000000"}
     fl = FleetLauncher(
         model, replicas=args.fleet_replicas,
         workdir=os.path.join(work, "fleet"),
@@ -110,6 +226,7 @@ def fleet_mode(args) -> int:
         # short lease + fast health checks: a killed replica leaves
         # rotation quickly even before its breaker trips
         router_kwargs={"lease_sec": 3.0, "hc_sec": 0.5},
+        replica_faults=replica_faults,
         quiet=True)
     fl.start()
     try:
@@ -157,6 +274,8 @@ def fleet_mode(args) -> int:
         while time.perf_counter() < t_end:
             time.sleep(0.25)
             fl.reap_and_restart()  # keepalive
+            if args.slow:
+                continue  # the wedge IS the chaos; no kills
             if time.perf_counter() >= next_kill:
                 # victims come from the IN-ROTATION set (the router's
                 # view — an alive-but-still-warming restart is not a
@@ -184,15 +303,51 @@ def fleet_mode(args) -> int:
         for t in clients:
             t.join(30.0)
         restarts = fl.restarts
+        ejections = 0.0
+        wedged_desc = {}
+        if args.slow:
+            # the ejection evidence, read from the router's own state
+            # + metrics before teardown
+            try:
+                import urllib.request
+
+                import xgboost_tpu.fleet as fleet_pkg
+                mtext = urllib.request.urlopen(
+                    fl.url + "/metrics", timeout=5).read().decode()
+                ejections = fleet_pkg.scrape_samples(mtext).get(
+                    "xgbtpu_fleet_slow_ejections_total", 0.0)
+                wedged_desc = [m for m in fl.members()["replicas"]
+                               if m["replica_id"] == f"r{wedged}"][0]
+            except (OSError, ValueError, IndexError) as e:
+                print(f"[chaos-fleet] metric scrape failed: {e}",
+                      file=sys.stderr)
         fl.stop()
 
-    report = {"mode": "fleet", "replicas": args.fleet_replicas,
+    report = {"mode": "fleet_slow" if args.slow else "fleet",
+              "replicas": args.fleet_replicas,
               "duration_sec": args.fleet_secs, "kills": kills,
               "keepalive_restarts": restarts, **counts,
               "non_shed_failures": counts["fail"]}
+    if args.slow:
+        report.update({
+            "wedged_replica": f"r{wedged}",
+            "slow_delay_sec": args.slow_delay,
+            "slow_ejections": ejections,
+            "wedged_final": {k: wedged_desc.get(k)
+                             for k in ("ejected", "latency_ewma_ms",
+                                       "breaker", "in_rotation")},
+        })
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
+    if args.slow:
+        print(f"[chaos-fleet] SLOW mode: {counts['ok']} ok, "
+              f"{counts['shed']} shed, {counts['fail']} FAILED; "
+              f"{ejections:.0f} ejection(s), wedged final "
+              f"{report['wedged_final']} -> {args.out}", file=sys.stderr)
+        if counts["fail"] or ejections < 1 or not counts["ok"]:
+            return 1
+        return 0
     print(f"[chaos-fleet] {counts['ok']} ok, {counts['shed']} shed, "
           f"{counts['fail']} FAILED across {kills} kills / "
           f"{restarts} restarts -> {args.out}", file=sys.stderr)
@@ -413,6 +568,20 @@ def main(argv=None) -> int:
                     help="--fleet: how long to drive traffic")
     ap.add_argument("--kill-every", type=float, default=4.0,
                     help="--fleet: seconds between replica kills")
+    ap.add_argument("--slow", action="store_true",
+                    help="--fleet variant: wedge one replica with the "
+                         "slow_replica fault instead of killing any; "
+                         "asserts latency ejection routes around it "
+                         "with zero non-shed failures")
+    ap.add_argument("--slow-delay", type=float, default=0.6,
+                    help="--slow: seconds each wedged predict sleeps")
+    ap.add_argument("--train", action="store_true",
+                    help="stall-failure training mode: stall mock "
+                         "coordinates + heartbeat-watchdog gang "
+                         "restarts, bit-identical resume "
+                         "(TRAIN_CHAOS.json; see module docstring)")
+    ap.add_argument("--stall-window", type=float, default=4.0,
+                    help="--train: launcher --watchdog-stall-sec")
     ap.add_argument("--pipeline", action="store_true",
                     help="continuous-training mode: SIGKILL/corrupt "
                          "the train→gate→publish→reload boundary under "
@@ -422,12 +591,17 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.out is None:
         args.out = ("PIPELINE_CHAOS.json" if args.pipeline
+                    else "CHAOS_fleet_slow.json"
+                    if args.fleet and args.slow
                     else "CHAOS_fleet.json" if args.fleet
+                    else "TRAIN_CHAOS.json" if args.train
                     else "CHAOS.json")
     if args.pipeline:
         return pipeline_mode(args)
     if args.fleet:
         return fleet_mode(args)
+    if args.train:
+        return train_stall_mode(args)
 
     from xgboost_tpu.cli import main as cli_main
     from xgboost_tpu.profiling import reliability_metrics

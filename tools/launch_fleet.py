@@ -61,13 +61,14 @@ class RetryingPredictClient:
         return self._http.HTTPConnection(self._host, self._port,
                                          timeout=self._timeout)
 
-    def post(self, body: bytes):
+    def post(self, body: bytes, headers=None):
         """-> (status, detail).  status None = transport failure after
         the one retry (detail = error string); non-200 statuses carry a
         response-body excerpt in detail; 200 -> (200, None)."""
         for attempt in range(2):
             try:
-                self._conn.request("POST", "/predict", body=body)
+                self._conn.request("POST", "/predict", body=body,
+                                   headers=headers or {})
                 r = self._conn.getresponse()
                 out = r.read()
             except OSError as e:
@@ -97,7 +98,8 @@ class FleetLauncher:
                  port: int = 0, featurestore_mb: float = 0.0,
                  serve_args: Optional[List[str]] = None,
                  router_kwargs: Optional[dict] = None,
-                 quiet: bool = True, shared_model: bool = False):
+                 quiet: bool = True, shared_model: bool = False,
+                 replica_faults: Optional[Dict[int, str]] = None):
         self.model_path = model_path
         # shared_model: every replica polls the SAME file (the
         # continuous-training pipeline's publish path) instead of a
@@ -113,6 +115,10 @@ class FleetLauncher:
         self.serve_args = list(serve_args or [])
         self.router_kwargs = dict(router_kwargs or {})
         self.quiet = quiet
+        # per-replica XGBTPU_FAULTS specs (reliability/faults.py):
+        # chaos drivers arm e.g. slow_replica on ONE replica subprocess
+        # while its siblings stay healthy
+        self.replica_faults = dict(replica_faults or {})
         self.router = None
         self.procs: Dict[int, subprocess.Popen] = {}
         self.restarts = 0
@@ -139,7 +145,11 @@ class FleetLauncher:
 
     def spawn(self, i: int) -> subprocess.Popen:
         log = open(os.path.join(self.workdir, f"replica-{i}.log"), "ab")
+        env = dict(os.environ)
+        if i in self.replica_faults:
+            env["XGBTPU_FAULTS"] = self.replica_faults[i]
         p = subprocess.Popen(self._replica_cmd(i), stdout=log, stderr=log,
+                             env=env,
                              cwd=os.path.dirname(os.path.dirname(
                                  os.path.abspath(__file__))))
         log.close()  # the child holds its own fd

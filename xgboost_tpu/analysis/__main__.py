@@ -6,7 +6,7 @@ Exit-code contract (what CI keys off):
   1  findings
   2  usage / internal error
 
-Cross-file contract rules (XGT008-XGT011, analysis/contracts.py) run
+Cross-file contract rules (XGT008-XGT012, analysis/contracts.py) run
 alongside the per-file rules by default: facts are collected from the
 whole repo (package + ``tools/``) regardless of which subset of paths
 was scanned, because a contract is only checkable whole.  ``--changed
@@ -88,7 +88,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "baseline file and exit 0")
     ap.add_argument("--no-contracts", action="store_true",
                     help="skip the cross-file contract rules "
-                         "(XGT008-XGT011)")
+                         "(XGT008-XGT012)")
     ap.add_argument("--write-contracts", action="store_true",
                     help="regenerate ANALYSIS_CONTRACTS.json from the "
                          "extracted route/metric/knob/lock inventories "

@@ -29,7 +29,13 @@ enforced cross-file invariants with a two-phase engine:
    - **XGT011** static lock-order graph: nested lock acquisitions
      keyed by ``(class, lock attr)`` form a global digraph that must be
      acyclic — the static complement of the runtime LockRaceChecker,
-     which only sees orders a test happens to execute.
+     which only sees orders a test happens to execute;
+   - **XGT012** HTTP timeout discipline: every outbound HTTP call
+     (``urlopen``, ``http.client.HTTPConnection``) must pass an
+     explicit ``timeout`` — a timeout-less client blocked on a wedged
+     peer is a latent hang, exactly the stall failure the deadline /
+     watchdog / ejection machinery exists to bound (RELIABILITY.md
+     stall matrix).
 
 The extracted inventories are committed as ``ANALYSIS_CONTRACTS.json``
 (:meth:`ContractEngine.inventory`) so reviewers see contract diffs in
@@ -55,7 +61,7 @@ from xgboost_tpu.analysis.core import (FileContext, Finding, Suppressions,
                                        iter_py_files, terminal_name)
 
 #: the cross-file rule codes this engine owns
-CONTRACT_CODES = ("XGT008", "XGT009", "XGT010", "XGT011")
+CONTRACT_CODES = ("XGT008", "XGT009", "XGT010", "XGT011", "XGT012")
 
 #: one-line catalog entries (``--list-rules``)
 CONTRACT_RULE_DOCS = {
@@ -70,6 +76,9 @@ CONTRACT_RULE_DOCS = {
                "SERVE_PARAMS/FLEET_PARAMS keys consumed"),
     "XGT011": ("lock-order-cycle",
                "global nested-lock acquisition graph must be acyclic"),
+    "XGT012": ("http-timeout-discipline",
+               "every outbound HTTP call (urlopen / HTTPConnection) "
+               "must pass an explicit timeout"),
 }
 
 _HTTP_METHODS = frozenset({"GET", "POST", "PUT", "DELETE", "HEAD",
@@ -111,6 +120,8 @@ class Facts:
         self.params: List[Tuple[str, str, str, int]] = []
         # (file, outer 'Class.attr', inner 'Class.attr', line)
         self.lock_edges: List[Tuple[str, str, str, int]] = []
+        # (file, call 'urlopen'|'HTTPConnection'|..., line, has_timeout)
+        self.http_calls: List[Tuple[str, str, int, bool]] = []
         # file -> every string constant in it (param-consumption check)
         self.str_consts: Dict[str, Set[str]] = {}
         # file -> Suppressions (inline disables apply to contract
@@ -283,6 +294,7 @@ def collect_file(ctx: FileContext, facts: Facts) -> None:
         _collect_metric_ctor(ctx, node, res, facts)
         _collect_env_call(ctx, node, res, facts)
         _collect_client_call(node, add_client)
+        _collect_http_timeout(ctx, node, facts)
 
 
 def _collect_routes(ctx: FileContext, cls: ast.ClassDef,
@@ -452,6 +464,27 @@ def _collect_client_call(node: ast.Call, add_client) -> None:
             return
 
 
+#: outbound-HTTP constructors that take a ``timeout`` (XGT012).
+#: ``urlopen`` hangs forever without one; the two connection classes
+#: default to the GLOBAL socket timeout, which is None in practice.
+_HTTP_TIMEOUT_CALLS = frozenset({"urlopen", "HTTPConnection",
+                                 "HTTPSConnection"})
+
+
+def _collect_http_timeout(ctx: FileContext, node: ast.Call,
+                          facts: Facts) -> None:
+    """XGT012 facts: every outbound-HTTP constructor call, with
+    whether it passes an explicit timeout — the ``timeout=`` keyword,
+    or the 3rd positional (``urlopen(url, data, timeout)`` /
+    ``HTTPConnection(host, port, timeout)``)."""
+    fname = terminal_name(node.func)
+    if fname not in _HTTP_TIMEOUT_CALLS:
+        return
+    has_timeout = (any(kw.arg == "timeout" for kw in node.keywords)
+                   or len(node.args) >= 3)
+    facts.http_calls.append((ctx.path, fname, node.lineno, has_timeout))
+
+
 # ------------------------------------------------------------ doc parsing
 def _doc_metric_table(text: str) -> Dict[str, Tuple[Optional[str], int]]:
     """Parse OBSERVABILITY.md's metric inventory: backticked tokens in
@@ -575,6 +608,8 @@ class ContractEngine:
             findings += self._check_knobs(facts)
         if "XGT011" in self.codes:
             findings += self._check_locks(facts)
+        if "XGT012" in self.codes:
+            findings += self._check_timeouts(facts)
         findings += self._check_inventory_drift(facts)
         active: List[Finding] = []
         suppressed: List[Finding] = []
@@ -737,6 +772,20 @@ class ContractEngine:
                 "them all)"))
         return out
 
+    # ------------------------------------------------------------ XGT012
+    def _check_timeouts(self, facts: Facts) -> List[Finding]:
+        out = []
+        for file, call, line, has_timeout in facts.http_calls:
+            if has_timeout:
+                continue
+            out.append(self._finding(
+                "XGT012", file, line,
+                f"outbound HTTP call {call}(...) passes no explicit "
+                "timeout — blocked on a wedged peer it hangs this "
+                "thread forever (the stall the deadline/watchdog "
+                "machinery exists to bound); pass timeout="))
+        return out
+
     # -------------------------------------------------------- inventory
     def inventory(self) -> dict:
         """The committed-contract view of the extracted facts: stable,
@@ -758,6 +807,12 @@ class ContractEngine:
             if key not in params[table]:
                 params[table].append(key)
         edges = sorted({(o, i) for _, o, i, _ in facts.lock_edges})
+        # XGT012 inventory: every outbound-HTTP constructor site, with
+        # its timeout discipline (the checker keeps `true` the only
+        # value that survives tier-1, so this section is the committed
+        # proof the tree has no timeout-less client)
+        http_clients = sorted({(self._rel(f), call, has_t)
+                               for f, call, _, has_t in facts.http_calls})
         return {
             "version": 1,
             "http_routes": [
@@ -769,6 +824,9 @@ class ContractEngine:
             "env_knobs": sorted({k for _, k, _ in facts.knobs}),
             "cli_params": {t: sorted(ks) for t, ks in params.items()},
             "lock_edges": [list(e) for e in edges],
+            "http_clients": [
+                {"file": f, "call": c, "timeout": t}
+                for f, c, t in http_clients],
         }
 
     def contracts_path(self) -> str:
@@ -798,7 +856,8 @@ class ContractEngine:
                      "metric_families": "XGT009",
                      "env_knobs": "XGT010",
                      "cli_params": "XGT010",
-                     "lock_edges": "XGT011"}
+                     "lock_edges": "XGT011",
+                     "http_clients": "XGT012"}
 
     def _check_inventory_drift(self, facts: Facts) -> List[Finding]:
         """The committed ANALYSIS_CONTRACTS.json must match what the

@@ -154,16 +154,28 @@ class BoostLearnTask:
             # multi-host launcher only the matching worker installs the
             # coordinate (single-controller: rank 0 == the process).
             # 3-field specs apply to every rank.  Multiple coordinates:
-            # semicolon-separated.
+            # semicolon-separated.  A "stall:" prefix makes the
+            # coordinate HANG instead of die (parallel/mock.py stall
+            # kind — detectable only by the gang launcher's
+            # --watchdog-stall-sec heartbeat watchdog, never by the
+            # in-process keepalive loop).
             for part in val.split(";"):
+                kind = "die"
+                if ":" in part:
+                    k, _, part = part.partition(":")
+                    kind = k.strip()
+                    if kind not in ("die", "stall"):
+                        raise ValueError(
+                            f"mock={part!r}: unknown kind {kind!r} "
+                            "(die|stall)")
                 nums = [int(x) for x in part.split(",") if x.strip() != ""]
                 if len(nums) == 3:
                     nums = [-1] + nums  # any rank
                 if len(nums) != 4:
                     raise ValueError(
                         f"mock={part!r}: expected "
-                        "[rank,]version,seqno,ntrial")
-                self.mock_spec.append(tuple(nums))
+                        "[kind:][rank,]version,seqno,ntrial")
+                self.mock_spec.append(tuple(nums) + (kind,))
         elif name == "keepalive":
             self.keepalive = int(val)
         elif name == "faults":
@@ -576,6 +588,9 @@ class BoostLearnTask:
             retry=bool(fp["fleet_retry"]),
             forward_timeout=fp["fleet_timeout_sec"],
             max_body_mb=fp["fleet_max_body_mb"],
+            deadline_ms=fp["fleet_deadline_ms"],
+            slow_eject_factor=fp["fleet_slow_eject_factor"],
+            slow_eject_cooldown_sec=fp["fleet_slow_eject_cooldown_sec"],
             rollout_defaults={
                 "canaries": fp["fleet_canaries"],
                 "soak_sec": fp["fleet_soak_sec"],

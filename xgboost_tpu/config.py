@@ -321,8 +321,26 @@ FLEET_PARAMS: Dict[str, Tuple[Any, str]] = {
                                         "before allowing one half-open "
                                         "probe request"),
     "fleet_retry": (1, "retry a failed /predict once on a different "
-                       "healthy replica (predictions are idempotent)"),
-    "fleet_timeout_sec": (30.0, "per-hop forward timeout to a replica"),
+                       "healthy replica (predictions are idempotent; "
+                       "the retry spends the request's REMAINING "
+                       "deadline budget after a jittered backoff)"),
+    "fleet_timeout_sec": (30.0, "per-hop forward timeout to a replica "
+                                "(shrunk to the remaining deadline "
+                                "budget when the request carries one)"),
+    "fleet_deadline_ms": (0.0, "default end-to-end deadline stamped "
+                               "(X-Deadline-Ms) on requests that carry "
+                               "none; expired requests are rejected 504 "
+                               "before any dispatch (0 = off)"),
+    "fleet_slow_eject_factor": (3.0, "eject a replica from least-"
+                                     "loaded dispatch when its latency "
+                                     "EWMA exceeds this multiple of "
+                                     "its peers' median (0 disables; "
+                                     "entity-id owners are exempt — "
+                                     "sticky routes have no failover)"),
+    "fleet_slow_eject_cooldown_sec": (5.0, "seconds an ejected replica "
+                                           "waits before one probe "
+                                           "request decides "
+                                           "readmission"),
     "fleet_max_body_mb": (64.0, "largest accepted request body (413 "
                                 "past it, before buffering)"),
     "fleet_canaries": (1, "default canary replica count for POST "

@@ -69,6 +69,19 @@ class Replica:
         self.consecutive_failures = 0
         self.breaker_opened_at = 0.0    # monotonic
         self.probe_inflight = False
+        # latency-aware ejection (distinct from the breaker: a
+        # slow-but-alive replica never fails a request, so the failure
+        # counter never sees it — the EWMA does)
+        self.lat_ewma = 0.0             # seconds; 0 = no samples yet
+        self.lat_samples = 0
+        self.ejected = False
+        self.ejected_at = 0.0           # monotonic
+        self.eject_probe_inflight = False
+        # thread id that was GRANTED the readmission probe: release()
+        # attributes the probe outcome only to that dispatch, so a
+        # concurrent entity-id hop (not ejection-gated) finishing fast
+        # cannot readmit a still-wedged replica
+        self.eject_probe_tid = 0
 
     def lease_live(self, now: float) -> bool:
         return now < self.lease_deadline
@@ -87,6 +100,8 @@ class Replica:
             "breaker": self.breaker,
             "consecutive_failures": self.consecutive_failures,
             "registered_count": self.registered_count,
+            "ejected": self.ejected,
+            "latency_ewma_ms": round(self.lat_ewma * 1e3, 3),
         }
 
 
@@ -146,13 +161,26 @@ class Membership:
     HALF-OPEN after ``breaker_cooldown_sec`` (exactly one probe
     request) -> CLOSED on success / OPEN again on failure."""
 
+    #: EWMA smoothing for per-replica dispatch latency (~last 25 obs)
+    LAT_ALPHA = 0.2
+    #: minimum EWMA samples (per replica) before ejection may fire —
+    #: one cold-start compile must not eject a fresh replica
+    EJECT_MIN_SAMPLES = 10
+
     def __init__(self, lease_sec: float = 10.0,
                  breaker_failures: int = 3,
                  breaker_cooldown_sec: float = 5.0,
+                 slow_eject_factor: float = 3.0,
+                 slow_eject_cooldown_sec: float = 5.0,
                  vnodes: int = 64):
         self.lease_sec = float(lease_sec)
         self.breaker_failures = int(breaker_failures)
         self.breaker_cooldown_sec = float(breaker_cooldown_sec)
+        # latency ejection: EWMA above factor x the PEERS' median
+        # ejects from least-loaded dispatch (0 disables); after the
+        # cooldown, ONE probe request decides readmission
+        self.slow_eject_factor = float(slow_eject_factor)
+        self.slow_eject_cooldown_sec = float(slow_eject_cooldown_sec)
         self._lock = threading.Lock()
         self._replicas: Dict[str, Replica] = {}
         self._ring = HashRing(vnodes)
@@ -176,7 +204,11 @@ class Membership:
                 self._replicas[replica_id] = rep
             else:
                 # a restarted process re-registers under its old id:
-                # fresh endpoint/pid, breaker and health start clean
+                # fresh endpoint/pid; breaker, health AND ejection
+                # state start clean (the fresh process neither inherits
+                # the wedged era's EWMA nor its ejection — and the
+                # EJECT_MIN_SAMPLES cold-start guard applies to it like
+                # any new replica)
                 rep.url = url.rstrip("/")
                 rep.model_path = model_path or rep.model_path
                 rep.model_hash = model_hash or rep.model_hash
@@ -185,13 +217,22 @@ class Membership:
                 rep.consecutive_failures = 0
                 rep.probe_inflight = False
                 rep.outstanding = 0
+                rep.ejected = False
+                rep.ejected_at = 0.0
+                rep.eject_probe_inflight = False
+                rep.eject_probe_tid = 0
+                rep.lat_ewma = 0.0
+                rep.lat_samples = 0
             rep.health_ok = True
             rep.health_state = "serving"
             rep.registered_count += 1
             rep.lease_deadline = now + self.lease_sec
             self._ring_stale = True
             total = len(self._replicas)
-        fleet_metrics().members_registered.set(total)
+        fm = fleet_metrics()
+        fm.members_registered.set(total)
+        if recovered:
+            fm.ejected.set(replica_id, 0.0)
         event("fleet.register", replica_id=replica_id, url=url,
               recovered=recovered)
         return {"lease_sec": self.lease_sec, "recovered": recovered}
@@ -274,31 +315,68 @@ class Membership:
         rep.probe_inflight = True
         return True
 
+    def _eject_allows_locked(self, rep: Replica, now: float) -> bool:
+        """Latency-ejection gate (the breaker's slow twin): an ejected
+        replica takes no traffic until its cooldown elapses, then
+        exactly ONE probe request at a time decides readmission."""
+        if not rep.ejected:
+            return True
+        if now - rep.ejected_at < self.slow_eject_cooldown_sec:
+            return False
+        if rep.eject_probe_inflight:
+            return False
+        rep.eject_probe_inflight = True
+        # the probe outcome belongs to THIS dispatch (acquire and
+        # release run on one thread end to end)
+        rep.eject_probe_tid = threading.get_ident()
+        return True
+
+    @staticmethod
+    def _giveback_probe_slots_locked(allowed, chosen) -> None:
+        """Un-take the single-probe slots of candidates that passed the
+        gates but were not picked (both the breaker's half-open slot
+        and the ejection's readmission slot)."""
+        for r in allowed:
+            if r is chosen:
+                continue
+            if r.breaker == BREAKER_HALF_OPEN and r.probe_inflight:
+                r.probe_inflight = False
+            if r.ejected and r.eject_probe_inflight:
+                r.eject_probe_inflight = False
+                r.eject_probe_tid = 0
+
     def acquire(self, exclude=()) -> Optional[Replica]:
         """Pick the LEAST-LOADED dispatch target (fewest outstanding
-        requests) over in-rotation, breaker-permitting replicas and
-        count it as outstanding.  ``exclude`` removes replicas already
-        tried (the retry path).  Entity-id traffic uses
-        :meth:`acquire_specific` on the resolved ring owner instead.
-        Callers MUST pair with :meth:`release`."""
+        requests) over in-rotation, breaker- and ejection-permitting
+        replicas and count it as outstanding.  ``exclude`` removes
+        replicas already tried (the retry path).  Entity-id traffic
+        uses :meth:`acquire_specific` on the resolved ring owner
+        instead.  Callers MUST pair with :meth:`release`."""
         now = time.monotonic()
         rotation = {r.replica_id for r in self.in_rotation()}
         with self._lock:
             candidates = [r for rid, r in self._replicas.items()
                           if rid in rotation and rid not in exclude]
-            allowed = [r for r in candidates
-                       if self._breaker_allows_locked(r, now)]
-            # _breaker_allows_locked marks a half-open probe slot taken;
-            # give back the slots of candidates we do not pick
+            allowed = []
+            for r in candidates:
+                if not self._breaker_allows_locked(r, now):
+                    continue
+                if not self._eject_allows_locked(r, now):
+                    # give back the breaker's half-open slot the first
+                    # gate just took — a leaked slot blocks every
+                    # future breaker probe on this replica
+                    if r.breaker == BREAKER_HALF_OPEN and r.probe_inflight:
+                        r.probe_inflight = False
+                    continue
+                allowed.append(r)
+            # the gates mark single-probe slots taken; give back the
+            # slots of candidates we do not pick
             chosen: Optional[Replica] = None
             if allowed:
                 chosen = min(allowed,
                              key=lambda r: (r.outstanding,
                                             r.replica_id))
-            for r in allowed:
-                if (r is not chosen and r.breaker == BREAKER_HALF_OPEN
-                        and r.probe_inflight):
-                    r.probe_inflight = False
+            self._giveback_probe_slots_locked(allowed, chosen)
             if chosen is None:
                 return None
             chosen.outstanding += 1
@@ -307,7 +385,15 @@ class Membership:
     def acquire_specific(self, replica_id: str) -> Optional[Replica]:
         """Count a dispatch against ONE named replica (the router's
         split-merge path already resolved ring ownership): in-rotation
-        and breaker-permitting, else None.  Pair with :meth:`release`."""
+        and breaker-permitting, else None.  Pair with :meth:`release`.
+
+        Deliberately NOT ejection-gated: entity-id traffic is sticky by
+        design (the owner holds the resident rows — there is no correct
+        replica to route around TO), and the invalidate broadcast must
+        reach a wedged-but-alive replica or it serves stale rows after
+        readmission.  A slow owner answers its entity traffic late;
+        latency ejection shapes only the LEAST-LOADED pool, where an
+        alternative exists (:meth:`acquire`)."""
         now = time.monotonic()
         rotation = {r.replica_id for r in self.in_rotation()}
         with self._lock:
@@ -343,16 +429,63 @@ class Membership:
                 out.setdefault(rid, []).append(i)
         return out
 
-    def release(self, rep: Replica, ok: bool) -> None:
-        """Report a dispatch outcome: drives load counts AND the
-        breaker state machine."""
+    def _peer_median_lat_locked(self, rep: Replica) -> float:
+        """Median of the LEASE-LIVE peers' latency EWMAs — the
+        ejection comparator.  Excluding ``rep`` itself matters: in a
+        2-replica fleet a median that includes the wedged replica's
+        own EWMA can never be exceeded by ``factor >= 2`` no matter
+        how slow it gets (b > f*(a+b)/2 is unsatisfiable), silently
+        disabling the feature in the most common small-fleet shape.
+        Excluding lease-dead members matters too: a killed replica's
+        stale (possibly wedged-era) EWMA would otherwise skew the
+        comparator forever — only deregister() removes entries.  0.0
+        when no live peer has samples (a fleet of one has no 'slow
+        relative to whom')."""
+        now = time.monotonic()
+        vals = sorted(r.lat_ewma for r in self._replicas.values()
+                      if r is not rep and r.lat_samples > 0
+                      and r.lease_live(now))
+        if not vals:
+            return 0.0
+        n = len(vals)
+        return (vals[n // 2] if n % 2
+                else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+
+    def release(self, rep: Replica, ok: Optional[bool],
+                latency: Optional[float] = None) -> None:
+        """Report a dispatch outcome: drives load counts, the breaker
+        state machine, AND (with ``latency``, successful hops only) the
+        per-replica latency EWMA behind slow ejection.  A replica whose
+        EWMA exceeds ``slow_eject_factor`` x its PEERS' median leaves
+        least-loaded dispatch until a post-cooldown probe comes back
+        fast — the stall analog of the breaker, for replicas that never
+        FAIL a request but wreck the fleet p99 answering it.
+
+        ``ok=None`` is a NEUTRAL release: the hop was cut short by the
+        REQUEST'S deadline budget, not by the replica — load counts and
+        probe slots are returned, but neither the breaker nor the EWMA
+        is charged (a few tight-budget clients must not trip a healthy
+        replica's breaker for everyone else)."""
         from xgboost_tpu.obs import event
         from xgboost_tpu.obs.metrics import fleet_metrics
         tripped = False
+        ejected_now = False
+        readmitted = False
         with self._lock:
             rep.outstanding = max(0, rep.outstanding - 1)
             if rep.breaker == BREAKER_HALF_OPEN:
                 rep.probe_inflight = False
+            # probe attribution is by thread token: a concurrent
+            # entity-id hop releasing on an ejected replica must not
+            # be mistaken for the readmission probe (nor free its slot)
+            was_eject_probe = (rep.ejected and rep.eject_probe_inflight
+                               and rep.eject_probe_tid
+                               == threading.get_ident())
+            if was_eject_probe:
+                rep.eject_probe_inflight = False
+                rep.eject_probe_tid = 0
+            if ok is None:
+                return
             if ok:
                 rep.consecutive_failures = 0
                 if rep.breaker != BREAKER_CLOSED:
@@ -369,10 +502,56 @@ class Membership:
                     rep.breaker = BREAKER_OPEN
                     rep.breaker_opened_at = time.monotonic()
                     tripped = True
+                if was_eject_probe:
+                    # a FAILED readmission probe stays ejected for
+                    # another cooldown (the breaker will handle the
+                    # failure side on its own)
+                    rep.ejected_at = time.monotonic()
+            if ok and latency is not None:
+                rep.lat_ewma = (latency if rep.lat_samples == 0
+                                else (1 - self.LAT_ALPHA) * rep.lat_ewma
+                                + self.LAT_ALPHA * latency)
+                rep.lat_samples += 1
+                median = self._peer_median_lat_locked(rep)
+                if was_eject_probe:
+                    if (median <= 0.0
+                            or latency <= self.slow_eject_factor * median):
+                        # the probe came back at fleet speed: readmit,
+                        # and restart the EWMA from the probe (the old
+                        # wedged-era average must not re-eject it)
+                        rep.ejected = False
+                        rep.lat_ewma = latency
+                        rep.lat_samples = 1
+                        readmitted = True
+                    else:
+                        rep.ejected_at = time.monotonic()
+                elif (not rep.ejected
+                      and self.slow_eject_factor > 0.0
+                      and rep.lat_samples >= self.EJECT_MIN_SAMPLES
+                      and median > 0.0
+                      and rep.lat_ewma
+                      > self.slow_eject_factor * median):
+                    rep.ejected = True
+                    rep.ejected_at = time.monotonic()
+                    rep.eject_probe_inflight = False
+                    ejected_now = True
             state = rep.breaker
+            ewma = rep.lat_ewma
+            is_ejected = rep.ejected
         fm = fleet_metrics()
         fm.breaker_open.set(rep.replica_id,
                             0.0 if state == BREAKER_CLOSED else 1.0)
+        if latency is not None:
+            fm.replica_latency.set(rep.replica_id, ewma)
+        if ejected_now or readmitted:
+            fm.ejected.set(rep.replica_id, 1.0 if is_ejected else 0.0)
+        if ejected_now:
+            fm.slow_ejections.inc()
+            event("fleet.slow_eject", replica_id=rep.replica_id,
+                  latency_ewma_ms=round(ewma * 1e3, 3))
+        if readmitted:
+            event("fleet.slow_readmit", replica_id=rep.replica_id,
+                  probe_latency_ms=round(ewma * 1e3, 3))
         if tripped:
             fm.breaker_trips.inc()
             event("fleet.breaker_open", replica_id=rep.replica_id,
@@ -513,7 +692,12 @@ class LeaseClient:
     # ----------------------------------------------------------- lifecycle
     def _loop(self) -> None:
         from xgboost_tpu.reliability import faults
-        while not self._stop.wait(max(self.lease_sec / 3.0, 0.05)):
+        from xgboost_tpu.reliability.deadline import jittered
+        # lease/3 nominal, ±20% jitter: a fleet restarted together must
+        # not renew in lockstep forever (every heartbeat tick would be
+        # a synchronized burst at the router)
+        while not self._stop.wait(
+                jittered(max(self.lease_sec / 3.0, 0.05))):
             try:
                 if not self.registered:
                     self.register()

@@ -53,6 +53,9 @@ from xgboost_tpu.obs import span, trace, trace_context
 from xgboost_tpu.obs.metrics import fleet_metrics
 from xgboost_tpu.obs.server import PROM_CONTENT_TYPE
 from xgboost_tpu.fleet.membership import Membership, Replica
+from xgboost_tpu.reliability.deadline import (DEADLINE_HEADER, Deadline,
+                                              DeadlineExceeded,
+                                              backoff_delay, jittered)
 
 
 class ForwardError(RuntimeError):
@@ -259,23 +262,38 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def _proxy_predict(self, url, body: bytes) -> None:
         rt: FleetRouter = self.server.router
         self._proxy(url, body,
-                    lambda path_qs, hdrs, sp: rt.dispatch(
-                        "POST", path_qs, body, hdrs, sp))
+                    lambda path_qs, hdrs, sp, dl: rt.dispatch(
+                        "POST", path_qs, body, hdrs, sp, deadline=dl))
 
     def _proxy_by_id(self, url, body: bytes) -> None:
         rt: FleetRouter = self.server.router
         self._proxy(url, body,
-                    lambda path_qs, hdrs, sp: rt.dispatch_by_id(
-                        url.path, path_qs, body, hdrs, sp))
+                    lambda path_qs, hdrs, sp, dl: rt.dispatch_by_id(
+                        url.path, path_qs, body, hdrs, sp, deadline=dl))
 
     def _proxy(self, url, body: bytes, dispatch_fn) -> None:
         """THE proxy shell shared by every forwarded route: admission
-        (budget shed -> 503), the router.request span under the
-        client's trace id, and the error mapping (NoReplica -> 503,
-        ForwardError -> 502, bad by-id payload -> 400)."""
+        (budget shed -> 503, expired deadline -> 504), the
+        router.request span under the client's trace id, and the error
+        mapping (NoReplica -> 503, ForwardError -> 502, spent deadline
+        -> 504, bad by-id payload -> 400)."""
         rid = self.headers.get("X-Request-Id") or trace.new_id()
         self._request_id = rid
         rt: FleetRouter = self.server.router
+        # the request's end-to-end budget: the client's X-Deadline-Ms,
+        # or the router's fleet_deadline_ms default when configured —
+        # every downstream hop SPENDS from this one object
+        dl = Deadline.from_headers(self.headers)
+        if dl is None and rt.deadline_ms > 0:
+            dl = Deadline(rt.deadline_ms)
+        if dl is not None and dl.expired():
+            # reject before any dispatch: nobody is waiting for this
+            from xgboost_tpu.profiling import reliability_metrics
+            reliability_metrics().deadline_rejected.inc()
+            self._send_json(504, {"error": "deadline expired before "
+                                           "dispatch",
+                                  "deadline_exceeded": True})
+            return
         if not rt.enter_request():
             fleet_metrics().shed.inc()
             self.close_connection = True
@@ -288,10 +306,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 with span("router.request", request_id=rid,
                           path=url.path) as sp:
                     status, headers, out = dispatch_fn(
-                        _path_qs(url), self._fwd_headers(rid), sp)
+                        _path_qs(url), self._fwd_headers(rid, dl), sp,
+                        dl)
             self._relay(status, headers, out)
         except NoReplica:
             self._send_json(503, {"error": "no replica available"})
+        except DeadlineExceeded as e:
+            from xgboost_tpu.profiling import reliability_metrics
+            reliability_metrics().deadline_rejected.inc()
+            self._send_json(504, {"error": str(e),
+                                  "deadline_exceeded": True})
         except ForwardError as e:
             self._send_json(502, {"error": str(e)})
         except ValueError as e:
@@ -299,8 +323,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
         finally:
             rt.exit_request()
 
-    def _fwd_headers(self, rid: str) -> Dict[str, str]:
+    def _fwd_headers(self, rid: str, dl=None) -> Dict[str, str]:
         h = {"X-Request-Id": rid}
+        if dl is not None:
+            # stamp the REMAINING budget (never the original): queue
+            # time at this hop is charged to the request
+            h[DEADLINE_HEADER] = dl.header_value()
         ctype = self.headers.get("Content-Type")
         if ctype:
             h["Content-Type"] = ctype
@@ -342,13 +370,21 @@ class FleetRouter:
                  retry: bool = True,
                  forward_timeout: float = 30.0,
                  max_body_mb: float = 64.0,
+                 deadline_ms: float = 0.0,
+                 slow_eject_factor: float = 3.0,
+                 slow_eject_cooldown_sec: float = 5.0,
                  rollout_defaults: Optional[dict] = None,
                  quiet: bool = True):
         self.membership = Membership(
             lease_sec=lease_sec, breaker_failures=breaker_failures,
-            breaker_cooldown_sec=breaker_cooldown_sec)
+            breaker_cooldown_sec=breaker_cooldown_sec,
+            slow_eject_factor=slow_eject_factor,
+            slow_eject_cooldown_sec=slow_eject_cooldown_sec)
         self.hc_sec = float(hc_sec)
         self.inflight_budget = int(inflight_budget)
+        # default end-to-end budget stamped on requests that carry no
+        # X-Deadline-Ms of their own (0 = none)
+        self.deadline_ms = float(deadline_ms)
         self.retry = bool(retry)
         self.max_body_bytes = int(max_body_mb * (1 << 20))
         self.rollout_defaults = dict(rollout_defaults or {})
@@ -390,12 +426,31 @@ class FleetRouter:
 
     # --------------------------------------------------------- forwarding
     def _forward(self, rep: Replica, method: str, path_qs: str,
-                 body: bytes, headers: Dict[str, str]
+                 body: bytes, headers: Dict[str, str],
+                 timeout: Optional[float] = None,
+                 deadline: Optional[Deadline] = None
                  ) -> Tuple[int, Dict[str, str], bytes]:
         """One HTTP hop to one replica over the keep-alive pool.
         Raises :class:`ForwardError` on transport failure or a
-        retryable status; other statuses (2xx/4xx) return verbatim."""
+        retryable status; other statuses (2xx/4xx) return verbatim.
+        ``timeout`` overrides the pool default for THIS hop (the
+        deadline path bounds each attempt by the remaining budget).
+
+        A hop that times out because the DEADLINE shrank its window —
+        the budget is spent when the timeout fires — raises
+        :class:`DeadlineExceeded` instead of ForwardError: the replica
+        did not fail, the request ran out of money, and charging the
+        breaker would let a few tight-budget clients 503 a healthy
+        replica for everyone (callers release neutrally)."""
         conn = self._pool.acquire(rep.url)
+        # always (re)set: a pooled socket remembers the previous hop's
+        # deadline-shortened timeout otherwise.  Applies to both a
+        # fresh connect (conn.timeout is read at connect()) and a
+        # pooled socket already connected.
+        t = self._pool.timeout if timeout is None else timeout
+        conn.timeout = t
+        if conn.sock is not None:
+            conn.sock.settimeout(t)
         try:
             hdrs = dict(headers)
             hdrs["Content-Length"] = str(len(body))
@@ -408,6 +463,14 @@ class FleetRouter:
                     if (v := resp.getheader(k)) is not None}
         except Exception as e:
             conn.close()
+            # socket.timeout is TimeoutError since 3.10; a connect
+            # REFUSED stays a ForwardError (the breaker should see a
+            # dead replica even from tight-budget traffic)
+            if (deadline is not None and deadline.expired()
+                    and isinstance(e, TimeoutError)):
+                raise DeadlineExceeded(
+                    f"budget exhausted mid-hop to {rep.replica_id}"
+                ) from e
             raise ForwardError(rep.replica_id,
                                f"{type(e).__name__}: {e}") from e
         if will_close:
@@ -422,18 +485,32 @@ class FleetRouter:
                                status=status)
         return status, keep, out
 
+    def _hop_timeout(self, deadline: Optional[Deadline]
+                     ) -> Optional[float]:
+        """Per-attempt forward timeout: the pool default, shrunk to the
+        request's remaining budget when one exists — a hop must never
+        outwait the caller."""
+        if deadline is None:
+            return None
+        return max(0.01, min(self._pool.timeout, deadline.remaining()))
+
     def dispatch(self, method: str, path_qs: str, body: bytes,
-                 headers: Dict[str, str], sp=None
+                 headers: Dict[str, str], sp=None,
+                 deadline: Optional[Deadline] = None
                  ) -> Tuple[int, Dict[str, str], bytes]:
         """Route one LEAST-LOADED request (`/predict`): forward, and —
         on failure — retry ONCE on a different replica (predictions are
-        idempotent).  Breaker + per-replica metrics are driven from the
-        outcomes.  Entity-id routes never come through here: they
-        address their ring owner single-attempt (:meth:`_dispatch_owner`
-        — a put retried on the ring successor while the owner is merely
-        slow would store rows where no later predict looks, and a by-id
-        predict retried there answers a wrong 404; entity traffic fails
-        over only when MEMBERSHIP changes)."""
+        idempotent), after a jittered backoff, spending the REMAINING
+        deadline budget rather than arming a fresh timeout.  Breaker +
+        per-replica metrics are driven from the outcomes, and each
+        successful hop's latency feeds the membership's per-replica
+        EWMA (the latency-ejection signal).  Entity-id routes never
+        come through here: they address their ring owner single-attempt
+        (:meth:`_dispatch_owner` — a put retried on the ring successor
+        while the owner is merely slow would store rows where no later
+        predict looks, and a by-id predict retried there answers a
+        wrong 404; entity traffic fails over only when MEMBERSHIP
+        changes)."""
         fm = fleet_metrics()
         t0 = time.perf_counter()
         tried: List[str] = []
@@ -441,6 +518,19 @@ class FleetRouter:
         last_err: Optional[ForwardError] = None
         try:
             for attempt in range(attempts):
+                if deadline is not None and deadline.expired():
+                    # the budget died with the last attempt: a retry
+                    # would burn a replica on an answer nobody reads
+                    if sp is not None:
+                        sp.set("status", 504)
+                    raise DeadlineExceeded(
+                        "deadline spent after "
+                        f"{attempt} attempt(s)")
+                if attempt:
+                    # jittered backoff before the retry (a fleet that
+                    # retries in lockstep re-overloads the survivor),
+                    # bounded so it never eats the remaining budget
+                    time.sleep(backoff_delay(attempt, deadline=deadline))
                 rep = self.membership.acquire(exclude=tried)
                 if rep is None:
                     break
@@ -451,15 +541,32 @@ class FleetRouter:
                     # not a retry
                     fm.retries.inc()
                 fm.requests.inc(rep.replica_id)
+                hdrs_out = dict(headers)
+                if deadline is not None:
+                    # restamped per attempt: the retry hop sees what is
+                    # actually left, not the first hop's budget
+                    hdrs_out[DEADLINE_HEADER] = deadline.header_value()
+                t_hop = time.perf_counter()
                 try:
                     status, hdrs, out = self._forward(
-                        rep, method, path_qs, body, headers)
+                        rep, method, path_qs, body, hdrs_out,
+                        timeout=self._hop_timeout(deadline),
+                        deadline=deadline)
+                except DeadlineExceeded:
+                    # the BUDGET cut the hop short, not the replica:
+                    # neutral release (no breaker/EWMA charge), 504 out
+                    self.membership.release(rep, ok=None)
+                    if sp is not None:
+                        sp.set("status", 504)
+                    raise
                 except ForwardError as e:
                     self.membership.release(rep, ok=False)
                     fm.errors.inc(rep.replica_id)
                     last_err = e
                     continue
-                self.membership.release(rep, ok=True)
+                self.membership.release(
+                    rep, ok=True,
+                    latency=time.perf_counter() - t_hop)
                 if sp is not None:
                     sp.set("replica", rep.replica_id)
                     sp.set("status", status)
@@ -478,13 +585,20 @@ class FleetRouter:
 
     # ----------------------------------------------- id-keyed dispatching
     def dispatch_by_id(self, path: str, path_qs: str, body: bytes,
-                       headers: Dict[str, str], sp=None
+                       headers: Dict[str, str], sp=None,
+                       deadline: Optional[Deadline] = None
                        ) -> Tuple[int, Dict[str, str], bytes]:
         """Consistent-hash dispatch for the entity-id routes.  The
         common case — every id owned by one replica — forwards the body
         verbatim (responses stay byte-identical to a direct replica
         call); requests spanning owners split into per-replica
-        sub-requests whose responses merge in input order."""
+        sub-requests whose responses merge in input order.  The
+        deadline budget (already stamped on ``headers`` by the proxy
+        shell) bounds each owner hop; entity hops are single-attempt,
+        so the only deadline decision here is not starting one that
+        cannot finish."""
+        if deadline is not None and deadline.expired():
+            raise DeadlineExceeded("deadline spent before owner dispatch")
         try:
             req = json.loads(body) if body.strip() else {}
         except ValueError as e:
@@ -505,11 +619,14 @@ class FleetRouter:
             # later predict will look — the same stance as the split
             # path below; the ring reroutes only on membership change
             (rid,) = groups
-            return self._dispatch_owner(rid, path_qs, body, headers, sp)
-        return self._split_merge(path, path_qs, req, groups, headers, sp)
+            return self._dispatch_owner(rid, path_qs, body, headers, sp,
+                                        deadline=deadline)
+        return self._split_merge(path, path_qs, req, groups, headers, sp,
+                                 deadline=deadline)
 
     def _dispatch_owner(self, rid: str, path_qs: str, body: bytes,
-                        headers: Dict[str, str], sp=None
+                        headers: Dict[str, str], sp=None,
+                        deadline: Optional[Deadline] = None
                         ) -> Tuple[int, Dict[str, str], bytes]:
         """One single-attempt hop to a NAMED replica (the resolved ring
         owner), with the same accounting dispatch() does."""
@@ -522,16 +639,25 @@ class FleetRouter:
             raise NoReplica()
         fm.requests.inc(rid)
         try:
+            t_hop = time.perf_counter()
             try:
-                status, hdrs, out = self._forward(rep, "POST", path_qs,
-                                                  body, headers)
+                status, hdrs, out = self._forward(
+                    rep, "POST", path_qs, body, headers,
+                    timeout=self._hop_timeout(deadline),
+                    deadline=deadline)
+            except DeadlineExceeded:
+                self.membership.release(rep, ok=None)
+                if sp is not None:
+                    sp.set("status", 504)
+                raise
             except ForwardError:
                 self.membership.release(rep, ok=False)
                 fm.errors.inc(rid)
                 if sp is not None:
                     sp.set("status", 502)
                 raise
-            self.membership.release(rep, ok=True)
+            self.membership.release(rep, ok=True,
+                                    latency=time.perf_counter() - t_hop)
             if sp is not None:
                 sp.set("replica", rid)
                 sp.set("status", status)
@@ -552,7 +678,8 @@ class FleetRouter:
 
     def _split_merge(self, path: str, path_qs: str, req: dict,
                      groups: Dict[str, List[int]],
-                     headers: Dict[str, str], sp=None
+                     headers: Dict[str, str], sp=None,
+                     deadline: Optional[Deadline] = None
                      ) -> Tuple[int, Dict[str, str], bytes]:
         """Fan a multi-owner id request out and merge the JSON
         responses: predictions land back in input order; missing-id
@@ -584,14 +711,21 @@ class FleetRouter:
                     sp.set("status", 503)
                 raise NoReplica()
             fm.requests.inc(rid)
+            t_hop = time.perf_counter()
             try:
-                status, _, out = self._forward(rep, "POST", path_qs,
-                                               sub, headers)
+                status, _, out = self._forward(
+                    rep, "POST", path_qs, sub, headers,
+                    timeout=self._hop_timeout(deadline),
+                    deadline=deadline)
+            except DeadlineExceeded:
+                self.membership.release(rep, ok=None)
+                raise
             except ForwardError:
                 self.membership.release(rep, ok=False)
                 fm.errors.inc(rid)
                 raise
-            self.membership.release(rep, ok=True)
+            self.membership.release(rep, ok=True,
+                                    latency=time.perf_counter() - t_hop)
             try:
                 payload = json.loads(out)
             except ValueError:
@@ -726,7 +860,9 @@ class FleetRouter:
 
     # ---------------------------------------------------------- lifecycle
     def _hc_loop(self) -> None:
-        while not self._stop.wait(self.hc_sec):
+        # ±20% jitter: N routers (or a router restarted with its fleet)
+        # must not probe every replica in lockstep forever
+        while not self._stop.wait(jittered(self.hc_sec)):
             try:
                 self.membership.health_check()
                 self._pool.prune(self.membership.urls())
@@ -787,6 +923,9 @@ def run_router(host: str = "127.0.0.1", port: int = 8000,
                inflight_budget: int = 256, breaker_failures: int = 3,
                breaker_cooldown_sec: float = 5.0, retry: bool = True,
                forward_timeout: float = 30.0, max_body_mb: float = 64.0,
+               deadline_ms: float = 0.0,
+               slow_eject_factor: float = 3.0,
+               slow_eject_cooldown_sec: float = 5.0,
                rollout_defaults: Optional[dict] = None,
                quiet: bool = False, block: bool = True
                ) -> Optional[FleetRouter]:
@@ -797,7 +936,9 @@ def run_router(host: str = "127.0.0.1", port: int = 8000,
                      breaker_failures=breaker_failures,
                      breaker_cooldown_sec=breaker_cooldown_sec,
                      retry=retry, forward_timeout=forward_timeout,
-                     max_body_mb=max_body_mb,
+                     max_body_mb=max_body_mb, deadline_ms=deadline_ms,
+                     slow_eject_factor=slow_eject_factor,
+                     slow_eject_cooldown_sec=slow_eject_cooldown_sec,
                      rollout_defaults=rollout_defaults, quiet=quiet)
     if not quiet:
         print(f"[fleet] router on http://{rt.host}:{rt.port} "

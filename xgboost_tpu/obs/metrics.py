@@ -369,10 +369,33 @@ class ReliabilityMetrics:
         self.drain_seconds = Gauge(
             f"{p}_drain_seconds",
             "duration of the last HTTP drain (SIGTERM to stopped)")
+        # deadline discipline (reliability/deadline.py): requests turned
+        # away BEFORE work because their budget was spent, and expired
+        # batch entries dropped before device dispatch
+        self.deadline_rejected = Counter(
+            "xgbtpu_deadline_rejected_total",
+            "requests rejected before device work because the deadline "
+            "budget was spent or cannot cover observed service time")
+        self.deadline_dropped = Counter(
+            "xgbtpu_deadline_dropped_total",
+            "expired requests dropped by the micro-batcher pre-dispatch")
+        # gang-launcher stall/death accounting (parallel/launch.py):
+        # RECOVERY.md recovery-cost bookkeeping, scrapeable like
+        # everything else instead of stderr-only
+        self.launch_worker_deaths = Counter(
+            "xgbtpu_launch_worker_deaths_total",
+            "worker processes observed dead nonzero by the gang "
+            "launcher")
+        self.launch_restarts = LabeledCounter(
+            "xgbtpu_launch_restarts_total", "reason",
+            "whole-gang restarts by the launcher, by reason "
+            "(death = nonzero worker exit, stall = watchdog kill)")
         self._all = (self.integrity_failures, self.ring_fallbacks,
                      self.quarantines, self.poisoned_reloads,
                      self.shed_requests, self.faults_injected,
-                     self.drain_seconds)
+                     self.drain_seconds, self.deadline_rejected,
+                     self.deadline_dropped, self.launch_worker_deaths,
+                     self.launch_restarts)
         registry().register("reliability", self.render)
 
     def render(self) -> str:
@@ -726,10 +749,25 @@ class FleetMetrics:
         self.rollbacks = Counter(
             f"{p}_rollbacks_total",
             "rollouts rolled back (gate failure or operator command)")
+        # latency-aware ejection (fleet/membership.py): a slow-but-alive
+        # replica sails under the failure-count breaker while wrecking
+        # fleet p99 — these make the ejection state machine scrapeable
+        self.slow_ejections = Counter(
+            f"{p}_slow_ejections_total",
+            "replicas ejected from least-loaded dispatch for latency "
+            "(EWMA above k x the peers' median)")
+        self.ejected = LabeledGauge(
+            f"{p}_ejected", "replica",
+            "1 while a replica is latency-ejected (awaiting its "
+            "readmission probe)")
+        self.replica_latency = LabeledGauge(
+            f"{p}_replica_latency_ewma_seconds", "replica",
+            "per-replica EWMA of router-observed dispatch latency")
         self._all = (self.requests, self.errors, self.latency, self.shed,
                      self.retries, self.breaker_trips, self.breaker_open,
                      self.members, self.members_registered, self.inflight,
-                     self.rollouts, self.rollbacks)
+                     self.rollouts, self.rollbacks, self.slow_ejections,
+                     self.ejected, self.replica_latency)
         registry().register("fleet", self.render)
 
     def render(self) -> str:

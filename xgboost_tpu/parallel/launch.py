@@ -36,9 +36,11 @@ from __future__ import annotations
 
 import argparse
 import os
+import shutil
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 from typing import List, Optional
 
@@ -46,6 +48,10 @@ COORD_ENV = "XGBTPU_COORD"
 NWORKER_ENV = "XGBTPU_NUM_WORKER"
 RANK_ENV = "XGBTPU_WORKER_ID"
 TRIAL_ENV = "XGBTPU_NUM_TRIAL"
+
+#: exit code launch_local returns for an unrecovered stall (no
+#: keepalive / restart budget exhausted) — worker rcs are small
+STALL_RC = 142
 
 
 def init_worker(local_device_count: Optional[int] = None) -> bool:
@@ -123,9 +129,33 @@ def _reap(procs: List[Optional[subprocess.Popen]],
             q.wait()
 
 
+def _latest_heartbeat(hb_dir: str) -> Optional[float]:
+    """Newest heartbeat-file mtime across ranks (monotonic-comparable
+    only against other mtimes from the same filesystem), or None when
+    no rank has beaten yet."""
+    latest = None
+    try:
+        names = os.listdir(hb_dir)
+    except OSError:
+        return None
+    for name in names:
+        if not name.startswith("hb-"):
+            continue
+        try:
+            m = os.stat(os.path.join(hb_dir, name)).st_mtime
+        except OSError:
+            continue  # racing a rewrite; the next poll sees it
+        if latest is None or m > latest:
+            latest = m
+    return latest
+
+
 def launch_local(n: int, cmd: List[str], keepalive: bool = False,
                  local_devices: Optional[int] = None,
-                 max_restarts: int = 10) -> int:
+                 max_restarts: int = 10,
+                 watchdog_stall_sec: float = 0.0,
+                 restart_backoff_sec: float = 0.5,
+                 standalone: bool = False) -> int:
     """Spawn ``n`` local worker processes running ``cmd`` (the
     rabit_demo.py submitter).
 
@@ -136,51 +166,134 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
     exactly the per-round-checkpoint fault model (SURVEY.md §5.3 TPU
     mapping).  The fresh port per attempt also sidesteps the
     free_port() probe/bind race.
+
+    ``watchdog_stall_sec > 0`` extends keepalive from death-detection
+    to STALL-detection (the reference's allreduce_robust timeout
+    recovery, RELIABILITY.md stall matrix): every worker touches a
+    per-rank heartbeat file at each round boundary
+    (``mock.begin_round``), and when ALL ranks stop advancing for that
+    long — a gang wedged in a collective, a worker hung in device code
+    — the launcher kills and restarts the gang exactly as it would for
+    a death.  The window must cover startup + the slowest single round
+    (data load and jit compilation count against it until the first
+    round lands).  Restarts draw from one ``max_restarts`` budget with
+    jittered exponential backoff between trials
+    (``restart_backoff_sec`` doubling per trial, capped at 30 s).
+
+    ``standalone=True`` supervises WITHOUT distributed rendezvous: no
+    ``XGBTPU_COORD`` is exported, so workers run single-controller and
+    the launcher contributes only keepalive + the stall watchdog —
+    process supervision for jobs (or containers) where the
+    ``jax.distributed`` mesh path is unavailable.
     """
-    trial = 0
-    while True:
-        coord = f"localhost:{free_port()}"
-        t_attempt = time.perf_counter()  # duration anchor (XGT006)
+    from xgboost_tpu.obs import event
+    from xgboost_tpu.profiling import reliability_metrics
+    from xgboost_tpu.reliability.deadline import backoff_delay
 
-        def spawn(rank: int) -> subprocess.Popen:
-            env = dict(os.environ)
-            env[COORD_ENV] = coord
-            env[NWORKER_ENV] = str(n)
-            env[RANK_ENV] = str(rank)
-            env[TRIAL_ENV] = str(trial)
-            if local_devices is not None:
-                env["XGBTPU_LOCAL_DEVICES"] = str(local_devices)
-            return subprocess.Popen(cmd, env=env)
+    hb_root = None
+    if watchdog_stall_sec > 0:
+        hb_root = tempfile.mkdtemp(prefix="xgbtpu_hb_")
+    try:
+        trial = 0
+        while True:
+            coord = f"localhost:{free_port()}"
+            t_attempt = time.perf_counter()  # duration anchor (XGT006)
+            hb_dir = None
+            if hb_root is not None:
+                # fresh beacon dir per attempt: a stale heartbeat from
+                # the previous trial must not vouch for this one
+                hb_dir = os.path.join(hb_root, f"t{trial}")
+                os.makedirs(hb_dir, exist_ok=True)
 
-        procs: List[Optional[subprocess.Popen]] = [spawn(r)
-                                                   for r in range(n)]
-        failed_rc = None
-        while any(p is not None for p in procs) and failed_rc is None:
-            time.sleep(0.2)
-            for r, p in enumerate(procs):
-                if p is None or p.poll() is None:
-                    continue
-                if p.returncode == 0:
-                    procs[r] = None
-                else:
-                    failed_rc = p.returncode
-                    print(f"[launch] worker {r} died "
-                          f"(rc={p.returncode}, trial {trial})",
-                          file=sys.stderr)
-                    break
-        if failed_rc is None:
-            return 0
-        t_detect = time.perf_counter()
-        _reap(procs)
-        if not keepalive or trial >= max_restarts:
-            return failed_rc
-        trial += 1
-        # recovery-cost accounting (RECOVERY.md): attempt wall time up
-        # to death detection, plus the reap (SIGTERM the survivors)
-        print(f"[launch] restarting all {n} workers, trial {trial} "
-              f"(attempt ran {t_detect - t_attempt:.2f}s, "
-              f"reap {time.perf_counter() - t_detect:.2f}s)",
-              file=sys.stderr)
+            def spawn(rank: int) -> subprocess.Popen:
+                env = dict(os.environ)
+                if not standalone:
+                    env[COORD_ENV] = coord
+                env[NWORKER_ENV] = str(n)
+                env[RANK_ENV] = str(rank)
+                env[TRIAL_ENV] = str(trial)
+                if hb_dir is not None:
+                    env["XGBTPU_HEARTBEAT_DIR"] = hb_dir
+                if local_devices is not None:
+                    env["XGBTPU_LOCAL_DEVICES"] = str(local_devices)
+                return subprocess.Popen(cmd, env=env)
+
+            procs: List[Optional[subprocess.Popen]] = [spawn(r)
+                                                       for r in range(n)]
+            # stall clock: progress = the newest heartbeat mtime CHANGED
+            # since the last poll (mtimes are wall-clock, so they are
+            # only ever compared with each other; the silence DURATION
+            # is measured on the monotonic clock, XGT006)
+            last_progress = time.monotonic()
+            last_hb_seen: Optional[float] = None
+            failed_rc = None
+            stalled = False
+            while any(p is not None for p in procs) and failed_rc is None:
+                time.sleep(0.2)
+                for r, p in enumerate(procs):
+                    if p is None or p.poll() is None:
+                        continue
+                    if p.returncode == 0:
+                        procs[r] = None
+                    else:
+                        failed_rc = p.returncode
+                        reliability_metrics().launch_worker_deaths.inc()
+                        event("launch.worker_death", rank=r,
+                              rc=p.returncode, trial=trial)
+                        print(f"[launch] worker {r} died "
+                              f"(rc={p.returncode}, trial {trial})",
+                              file=sys.stderr)
+                        break
+                if (failed_rc is None and hb_dir is not None
+                        and any(p is not None for p in procs)):
+                    # stall watchdog: progress = a NEW heartbeat from
+                    # any rank since the last poll (spawn time until
+                    # the first one lands — startup counts against the
+                    # window, so it must cover compile time)
+                    hb = _latest_heartbeat(hb_dir)
+                    if hb is not None and hb != last_hb_seen:
+                        last_hb_seen = hb
+                        last_progress = time.monotonic()
+                    silent = time.monotonic() - last_progress
+                    if silent > watchdog_stall_sec:
+                        stalled = True
+                        event("launch.stall", trial=trial,
+                              silent_sec=round(silent, 2),
+                              stall_window_sec=watchdog_stall_sec)
+                        print(f"[launch] STALL: no rank advanced for "
+                              f"{silent:.1f}s (> {watchdog_stall_sec}s"
+                              f", trial {trial}); killing the gang",
+                              file=sys.stderr)
+                        break
+            if failed_rc is None and not stalled:
+                return 0
+            t_detect = time.perf_counter()
+            _reap(procs)
+            if not keepalive or trial >= max_restarts:
+                return STALL_RC if stalled else failed_rc
+            trial += 1
+            reason = "stall" if stalled else "death"
+            reliability_metrics().launch_restarts.inc(reason)
+            event("launch.restart", reason=reason, trial=trial,
+                  attempt_sec=round(t_detect - t_attempt, 2))
+            # jittered exponential backoff between trials (the shared
+            # reliability helper): a crash loop (bad input, wedged
+            # device) must not hot-spin the host it is supposed to be
+            # recovering on
+            delay = backoff_delay(trial, base=restart_backoff_sec,
+                                  cap=30.0)
+            # recovery-cost accounting (RECOVERY.md): attempt wall time
+            # up to detection, plus the reap (SIGTERM the survivors)
+            print(f"[launch] restarting all {n} workers, trial {trial} "
+                  f"(reason {reason}, attempt ran "
+                  f"{t_detect - t_attempt:.2f}s, "
+                  f"reap {time.perf_counter() - t_detect:.2f}s, "
+                  f"backoff {delay:.2f}s)",
+                  file=sys.stderr)
+            time.sleep(delay)
+    finally:
+        if hb_root is not None:
+            shutil.rmtree(hb_root, ignore_errors=True)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -189,9 +302,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="spawn N distributed workers (rabit_demo.py analog)")
     ap.add_argument("-n", "--nworker", type=int, required=True)
     ap.add_argument("--keepalive", action="store_true",
-                    help="restart workers that die nonzero")
+                    help="restart workers that die nonzero (and gangs "
+                         "the stall watchdog kills)")
     ap.add_argument("--local-devices", type=int, default=None,
                     help="virtual CPU devices per worker (testing)")
+    ap.add_argument("--watchdog-stall-sec", type=float, default=0.0,
+                    help="kill+restart the gang when ALL ranks stop "
+                         "advancing (heartbeats at round boundaries) "
+                         "for this long; must cover startup + the "
+                         "slowest round (0 = off)")
+    ap.add_argument("--max-restarts", type=int, default=10,
+                    help="total gang restarts (death + stall) before "
+                         "giving up")
+    ap.add_argument("--restart-backoff-sec", type=float, default=0.5,
+                    help="base backoff between gang restarts "
+                         "(doubles per trial, jittered, capped 30s)")
+    ap.add_argument("--standalone", action="store_true",
+                    help="supervise without distributed rendezvous "
+                         "(no XGBTPU_COORD): keepalive + watchdog only")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if args.cmd and args.cmd[0] == "--":
@@ -199,7 +327,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.cmd:
         ap.error("missing worker command")
     return launch_local(args.nworker, args.cmd, keepalive=args.keepalive,
-                        local_devices=args.local_devices)
+                        local_devices=args.local_devices,
+                        max_restarts=args.max_restarts,
+                        watchdog_stall_sec=args.watchdog_stall_sec,
+                        restart_backoff_sec=args.restart_backoff_sec,
+                        standalone=args.standalone)
 
 
 if __name__ == "__main__":

@@ -13,6 +13,22 @@ round ("version").  ``ntrial`` counts process restarts, so an injection
 fires once and the restarted run sails past it — exactly the reference's
 mock semantics.
 
+Two fault KINDS share the coordinate space:
+
+- ``die`` (default) — raise :class:`WorkerFailure`, the reference
+  mock's ``exit(-2)``: a crash the keepalive restart must absorb;
+- ``stall`` — sleep at the coordinate (default effectively forever),
+  the HANG twin of death: the worker stays alive but stops making
+  progress, which only the gang launcher's heartbeat watchdog
+  (``parallel/launch.py``) can detect and kill.  The reference's
+  analog is ``allreduce_robust``'s timeout recovery — workers that
+  stop progressing, not just workers that exit.
+
+The round boundary (:func:`begin_round`) doubles as the LIVENESS
+beacon: when the launcher exports ``XGBTPU_HEARTBEAT_DIR``, every rank
+touches its per-rank heartbeat file there at each round, so "all ranks
+stopped advancing" is observable from outside the gang.
+
 Deterministic recovery holds because per-iteration seeding is derived by
 ``fold_in(seed, iteration)`` (the reference forces seed_per_iteration in
 distributed mode for the same reason, learner-inl.hpp:275-277).
@@ -26,7 +42,18 @@ must restart from; tests/test_reliability.py, tools/chaos_loop.py).
 
 from __future__ import annotations
 
+import os
+import sys
+import time
 from typing import List, Optional, Tuple
+
+#: directory of per-rank heartbeat files, exported by the gang
+#: launcher's watchdog (parallel/launch.py); unset = no beacon
+HEARTBEAT_DIR_ENV = "XGBTPU_HEARTBEAT_DIR"
+
+#: how long a ``stall`` fault sleeps — effectively forever: the point
+#: is that the WATCHDOG ends it (SIGTERM/SIGKILL), not the sleep
+STALL_SEC = 10_000.0
 
 
 class WorkerFailure(RuntimeError):
@@ -34,11 +61,25 @@ class WorkerFailure(RuntimeError):
 
 
 class FaultInjector:
-    """Dies when a registered (version, seqno, ntrial) coordinate is hit."""
+    """Fires when a registered (version, seqno, ntrial) coordinate is
+    hit: ``die`` raises :class:`WorkerFailure`, ``stall`` sleeps (the
+    hang twin — see module docstring).  Spec entries are 3-tuples
+    (die) or 4-tuples ``(version, seqno, ntrial, kind)``."""
 
-    def __init__(self, spec: List[Tuple[int, int, int]], trial: int = 0):
-        self.spec = set(spec)
+    def __init__(self, spec: List[Tuple], trial: int = 0,
+                 stall_sec: float = STALL_SEC):
+        self.spec = {}
+        for item in spec:
+            if len(item) == 3:
+                v, s, t = item
+                kind = "die"
+            else:
+                v, s, t, kind = item
+            if kind not in ("die", "stall"):
+                raise ValueError(f"unknown mock fault kind {kind!r}")
+            self.spec[(int(v), int(s), int(t))] = kind
         self.trial = trial
+        self.stall_sec = float(stall_sec)
         self.version = -1
         self.seqno = 0
 
@@ -49,25 +90,41 @@ class FaultInjector:
     def collective(self) -> None:
         coord = (self.version, self.seqno, self.trial)
         self.seqno += 1
-        if (self.version, coord[1], self.trial) in self.spec:
-            from xgboost_tpu.obs import trace
+        kind = self.spec.get(coord)
+        if kind is None:
+            return
+        from xgboost_tpu.obs import trace
+        if kind == "die":
             trace.event("fault.injected", kind="worker_death",
                         seam="collective", seqno=coord[1],
                         trial=self.trial)
             raise WorkerFailure(
                 f"[mock] die at version={coord[0]} seqno={coord[1]} "
                 f"trial={self.trial}")
+        trace.event("fault.injected", kind="worker_stall",
+                    seam="collective", seqno=coord[1], trial=self.trial)
+        print(f"[mock] stall at version={coord[0]} seqno={coord[1]} "
+              f"trial={self.trial} (heartbeats stop; the watchdog "
+              "must kill this gang)", file=sys.stderr)
+        sys.stderr.flush()
+        # sleep in slices so a SIGTERM from the launcher's reap lands
+        # between syscalls and the default handler exits promptly
+        deadline = time.monotonic() + self.stall_sec
+        while time.monotonic() < deadline:
+            time.sleep(0.25)
 
 
 _injector: Optional[FaultInjector] = None
 _calls = 0  # lifetime collective-seam entries (the report_stats count)
 
 
-def set_fault_injection(spec: List[Tuple[int, int, int]],
-                        trial: int = 0) -> None:
-    """Install a process-wide injector (reference mock= parameter)."""
+def set_fault_injection(spec: List[Tuple], trial: int = 0,
+                        stall_sec: float = STALL_SEC) -> None:
+    """Install a process-wide injector (reference mock= parameter).
+    Spec entries: ``(version, seqno, ntrial)`` for death, or
+    ``(version, seqno, ntrial, "stall")`` for a hang."""
     global _injector
-    _injector = FaultInjector(spec, trial)
+    _injector = FaultInjector(spec, trial, stall_sec=stall_sec)
 
 
 def clear_fault_injection() -> None:
@@ -75,11 +132,35 @@ def clear_fault_injection() -> None:
     _injector = None
 
 
+def touch_heartbeat(version: int) -> None:
+    """Touch this rank's heartbeat file (liveness beacon for the gang
+    launcher's stall watchdog).  No-op unless the launcher exported
+    ``XGBTPU_HEARTBEAT_DIR``.  Never raises: a beacon failure must not
+    kill a healthy worker."""
+    hb_dir = os.environ.get(HEARTBEAT_DIR_ENV)
+    if not hb_dir:
+        return
+    rank = os.environ.get("XGBTPU_WORKER_ID", "0")
+    try:
+        # a liveness beacon, not durable state: the watchdog reads only
+        # the mtime, so a torn write is harmless (the round number is
+        # a debugging courtesy)
+        with open(os.path.join(hb_dir, f"hb-{rank}"),  # xgtpu: disable=XGT003
+                  "w") as f:
+            f.write(str(version))
+    except OSError as e:
+        from xgboost_tpu.obs.metrics import swallowed_error
+        swallowed_error("parallel.mock.touch_heartbeat", e,
+                        emit_event=False)
+
+
 def begin_round(version: int) -> None:
     # the round boundary doubles as the observability round marker:
     # collective stats (obs/comm.py) and discrete events correlate by
-    # this version, the report_stats "version" role
+    # this version, the report_stats "version" role — AND as the
+    # per-rank liveness beacon the stall watchdog reads
     from xgboost_tpu.obs import comm, trace
+    touch_heartbeat(version)
     comm.begin_round(version)
     trace.set_round(version)
     if _injector is not None:

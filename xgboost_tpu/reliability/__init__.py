@@ -1,6 +1,6 @@
 """xgboost_tpu.reliability — crash-safe persistence + failure injection.
 
-Two modules wired through the whole stack (design in RELIABILITY.md):
+Three modules wired through the whole stack (design in RELIABILITY.md):
 
 - :mod:`~xgboost_tpu.reliability.integrity` — ``atomic_write`` (tmp +
   fsync + rename + dir fsync) and a CRC32 footer scheme so every
@@ -12,6 +12,11 @@ Two modules wired through the whole stack (design in RELIABILITY.md):
   bit flips, ENOSPC, slow reads, reload failures — selectable via the
   ``XGBTPU_FAULTS`` env var or the CLI ``faults=`` parameter, so chaos
   tests drive the REAL code paths.
+- :mod:`~xgboost_tpu.reliability.deadline` — the stall half of the
+  fault model: :class:`Deadline` budgets propagated end to end via
+  ``X-Deadline-Ms`` (router admission, replica
+  admission-by-service-time, batcher pre-dispatch drops), plus the
+  shared :func:`jittered` / :func:`backoff_delay` timing helpers.
 
 Consumers: ``Learner.save_model``/``load_model`` (atomic + checksummed
 model files), the CLI checkpoint ring (fallback to the older replica +
@@ -19,6 +24,9 @@ quarantine on corruption), and the serving ``ModelRegistry`` (verify
 before build, poisoned-fingerprint memory).
 """
 
+from xgboost_tpu.reliability.deadline import (DEADLINE_HEADER, Deadline,
+                                              DeadlineExceeded,
+                                              backoff_delay, jittered)
 from xgboost_tpu.reliability.faults import (InjectedFault, clear_faults,
                                             inject, install_spec)
 from xgboost_tpu.reliability.integrity import (ModelIntegrityError,
@@ -38,4 +46,9 @@ __all__ = [
     "inject",
     "clear_faults",
     "install_spec",
+    "DEADLINE_HEADER",
+    "Deadline",
+    "DeadlineExceeded",
+    "backoff_delay",
+    "jittered",
 ]

@@ -17,14 +17,19 @@ read_flip       flip one bit at byte N on read  ``integrity.read_file``
 reload          raise at the registry reload    ``ModelRegistry`` rebuild
 heartbeat_loss  drop a lease renewal            fleet ``LeaseClient``
 replica_kill    sudden replica death (no drain) fleet ``LeaseClient``
+slow_replica    sleep N sec per predict         replica predict path
 ============== =============================== =========================
 
-The two fleet kinds (``@path`` matches the replica id) prove the
-router's failure paths: ``heartbeat_loss`` lets a lease decay so the
-membership sweep drops the replica from rotation; ``replica_kill``
-fires the lease client's ``on_kill`` — ``os._exit(43)`` in a real
-replica process — without drain or deregistration, exactly the crash
-the health checker + retry-once dispatch must absorb.
+The three fleet kinds (``@path`` matches the replica id the lease
+client registered) prove the router's failure paths: ``heartbeat_loss``
+lets a lease decay so the membership sweep drops the replica from
+rotation; ``replica_kill`` fires the lease client's ``on_kill`` —
+``os._exit(43)`` in a real replica process — without drain or
+deregistration, exactly the crash the health checker + retry-once
+dispatch must absorb; ``slow_replica`` wedges the predict path (arg =
+seconds of added latency per request, lease + health still fine) —
+the stall twin of ``replica_kill``, which the router's latency-aware
+ejection (fleet/membership.py) must route around.
 
 Faults are armed with :func:`inject` (tests), the CLI ``faults=``
 parameter, or the ``XGBTPU_FAULTS`` env var (subprocess chaos drivers,
@@ -54,7 +59,8 @@ from typing import List, Optional
 
 _WRITE_KINDS = ("torn_write", "bit_flip", "enospc")
 _READ_KINDS = ("slow_read", "read_flip")
-_POINT_KINDS = ("reload", "heartbeat_loss", "replica_kill")
+_POINT_KINDS = ("reload", "heartbeat_loss", "replica_kill",
+                "slow_replica")
 _KINDS = _WRITE_KINDS + _READ_KINDS + _POINT_KINDS
 
 
@@ -213,6 +219,16 @@ def check(point: str, path: Optional[str] = None) -> None:
     rebuild).  Raises :class:`InjectedFault` when armed."""
     if _take((point,), path, seam=point):
         raise InjectedFault(point, str(path) if path else "")
+
+
+def delay_for(point: str, path: Optional[str] = None) -> float:
+    """Delay seam (``slow_replica``): seconds the calling hot path
+    should sleep, summed over every armed matching fault (0.0 = none).
+    Unlike :func:`check` this never raises — a wedged-but-alive
+    component keeps answering, just late, which is exactly the failure
+    the latency-ejection machinery exists for."""
+    return sum(float(f.arg if f.arg is not None else 0.25)
+               for f in _take((point,), path, seam=point))
 
 
 # subprocess chaos drivers arm faults via the environment; parse once at

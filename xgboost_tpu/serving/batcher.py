@@ -16,6 +16,16 @@ Abandoned requests are SHED: when a caller's ``submit(timeout=...)``
 wait expires, the request is marked abandoned and the worker skips it
 at flush time — no device dispatch is paid for a result nobody reads
 (counted on ``xgbtpu_reliability_shed_requests_total``).
+
+Deadlines compose with shedding (reliability/deadline.py): a request
+submitted with a :class:`~xgboost_tpu.reliability.deadline.Deadline`
+whose budget runs out while it waits in the queue is dropped at flush
+time BEFORE dispatch — its caller gets
+:class:`~xgboost_tpu.reliability.deadline.DeadlineExceeded` (HTTP 504
+at the front end) and the drop counts on
+``xgbtpu_deadline_dropped_total``.  Shedding covers callers that gave
+up; the deadline drop covers callers whose BUDGET gave up, which the
+worker can see without waiting for anyone's timeout.
 """
 
 from __future__ import annotations
@@ -34,15 +44,19 @@ class QueueFull(RuntimeError):
 
 class _Request:
     __slots__ = ("X", "output_margin", "done", "result", "error", "t0",
-                 "abandoned", "trace_id")
+                 "abandoned", "trace_id", "deadline")
 
-    def __init__(self, X: np.ndarray, output_margin: bool):
+    def __init__(self, X: np.ndarray, output_margin: bool, deadline=None):
         self.X = X
         self.output_margin = output_margin
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.t0 = time.perf_counter()
+        # optional Deadline budget: the worker drops this request
+        # pre-dispatch once it expires (the caller is answered with
+        # DeadlineExceeded instead of a late result)
+        self.deadline = deadline
         # set by submit() when its caller's wait timed out: the caller
         # is gone, so the worker sheds the request instead of paying
         # device dispatch for a result nobody will read
@@ -88,11 +102,17 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- submit
     def submit(self, X, output_margin: bool = False,
-               timeout: Optional[float] = None) -> np.ndarray:
+               timeout: Optional[float] = None,
+               deadline=None) -> np.ndarray:
         """Enqueue one request and block until its predictions arrive.
 
         Raises :class:`QueueFull` when accepting the rows would exceed
-        ``max_queue_rows`` (reject-don't-buffer backpressure)."""
+        ``max_queue_rows`` (reject-don't-buffer backpressure).  With a
+        ``deadline`` (:class:`~xgboost_tpu.reliability.deadline.
+        Deadline`), the wait is bounded by the remaining budget and the
+        worker drops the entry pre-dispatch once it expires (the caller
+        sees :class:`~xgboost_tpu.reliability.deadline.
+        DeadlineExceeded`)."""
         X = np.asarray(X, np.float32)
         if X.ndim != 2:
             raise ValueError(f"expected 2-D rows, got shape {X.shape}")
@@ -102,7 +122,13 @@ class MicroBatcher:
             # ones backpressure rejects (reject ratio must be computable
             # as rejected_total / requests_total)
             self.metrics.requests.inc()
-        req = _Request(X, output_margin)
+        if deadline is not None:
+            # the caller has no reason to outwait its own budget (plus
+            # a small grace so a pre-dispatch drop resolves the wait
+            # with the typed error, not a bare TimeoutError race)
+            budget = deadline.remaining() + 0.05
+            timeout = budget if timeout is None else min(timeout, budget)
+        req = _Request(X, output_margin, deadline=deadline)
         with self._lock:
             # closed-check AND enqueue under the same lock as close()'s
             # closed-set: a request can never land BEHIND the close
@@ -133,6 +159,11 @@ class MicroBatcher:
             # the flush already started, the result is computed and
             # simply dropped — never a wrong answer to a later caller.
             req.abandoned = True
+            if deadline is not None and deadline.expired():
+                from xgboost_tpu.reliability.deadline import \
+                    DeadlineExceeded
+                raise DeadlineExceeded(
+                    "deadline budget spent waiting for dispatch")
             raise TimeoutError("prediction timed out")
         if self.metrics is not None:
             self.metrics.latency.observe(time.perf_counter() - req.t0)
@@ -182,15 +213,32 @@ class MicroBatcher:
 
     def _flush(self, batch: List[_Request]) -> None:
         self._dequeue_rows(sum(r.X.shape[0] for r in batch))
+        # drop entries whose DEADLINE expired in the queue: unlike an
+        # abandoned request (caller gone, nothing to tell it), the
+        # caller here may still be waiting — answer it with the typed
+        # 504-mapping error instead of paying device dispatch for a
+        # result that arrives past its budget
+        expired = [r for r in batch if not r.abandoned
+                   and r.deadline is not None and r.deadline.expired()]
+        if expired:
+            from xgboost_tpu.profiling import reliability_metrics
+            from xgboost_tpu.reliability.deadline import DeadlineExceeded
+            reliability_metrics().deadline_dropped.inc(len(expired))
+            for r in expired:
+                r.error = DeadlineExceeded(
+                    "deadline expired before dispatch")
+                r.abandoned = True
+                r.done.set()
         # shed requests whose caller already timed out: their rows would
         # cost device dispatch (and inflate the batch's bucket) for a
         # result nobody is waiting on
         live = [r for r in batch if not r.abandoned]
         if len(live) < len(batch):
             from xgboost_tpu.profiling import reliability_metrics
-            reliability_metrics().shed_requests.inc(len(batch) - len(live))
+            reliability_metrics().shed_requests.inc(
+                len(batch) - len(live) - len(expired))
             for r in batch:
-                if r.abandoned:
+                if r.abandoned and r.error is None:
                     r.done.set()
             if not live:
                 return

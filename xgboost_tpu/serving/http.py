@@ -51,6 +51,7 @@ import numpy as np
 
 from xgboost_tpu.obs import span, trace, trace_context
 from xgboost_tpu.obs.server import PROM_CONTENT_TYPE
+from xgboost_tpu.reliability.deadline import Deadline, DeadlineExceeded
 from xgboost_tpu.serving.batcher import MicroBatcher, QueueFull
 from xgboost_tpu.serving.registry import ModelRegistry
 
@@ -290,10 +291,30 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             ps.exit_request()
 
+    def _deadline_reject(self, reason: str, dl, sp=None) -> None:
+        """504 a request whose budget cannot buy useful work — BEFORE
+        any parsing/device cost is spent on it (admission by deadline,
+        RELIABILITY.md stall matrix).  Counter-backed so 'rejected
+        early ≫ completed late' is assertable from /metrics."""
+        from xgboost_tpu.profiling import reliability_metrics
+        reliability_metrics().deadline_rejected.inc()
+        if sp is not None:
+            sp.set("status", 504)
+        self._send_json(504, {
+            "error": reason, "deadline_exceeded": True,
+            "remaining_ms": dl.describe_ms() if dl is not None else 0})
+
     def _predict_admitted(self, url, body: str, sp=None) -> None:
         def _st(code: int) -> None:
             if sp is not None:
                 sp.set("status", code)
+        ps: PredictServer = self.server.pserver
+        dl = Deadline.from_headers(self.headers)
+        if dl is not None and dl.expired():
+            # spent before we even parse: the router's stamp (or the
+            # client's) says nobody is waiting for this answer
+            self._deadline_reject("deadline expired on arrival", dl, sp)
+            return
         try:
             qs = parse_qs(url.query)
             fmt = qs.get("format", [None])[0]
@@ -320,11 +341,50 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if sp is not None:
             sp.set("rows", int(X.shape[0]))
+        if dl is not None:
+            # admission by deadline: when the remaining budget cannot
+            # cover this row-bucket's OBSERVED service time, a 504 now
+            # beats device work whose answer lands after the caller
+            # hung up (the stall analog of reject-don't-buffer)
+            est = ps.service_estimate(int(X.shape[0]))
+            if est > 0.0 and dl.remaining() < est:
+                # anti-latch: only completed predicts refresh the EWMA,
+                # so an estimate inflated by a past backlog could
+                # otherwise reject this bucket FOREVER once it exceeds
+                # every client's budget — each rejection decays it
+                # until requests are admitted and real observations
+                # take over
+                ps.decay_service(int(X.shape[0]))
+                self._deadline_reject(
+                    f"remaining budget {dl.describe_ms()}ms cannot "
+                    f"cover observed service time {est * 1e3:.1f}ms",
+                    dl, sp)
+                return
+        # chaos seam: `slow_replica` (keyed on this replica's fleet id,
+        # like the lease client's heartbeat_loss/replica_kill) wedges
+        # the predict path without killing anything — the
+        # latency-ejection machinery must route around it
+        from xgboost_tpu.reliability import faults
+        wedge = faults.delay_for(
+            "slow_replica",
+            path=(ps.lease_client.replica_id
+                  if ps.lease_client is not None else None))
+        if wedge > 0.0:
+            time.sleep(wedge)
+        t_submit = time.perf_counter()
         try:
-            preds = self.server.batcher.submit(X, output_margin=output_margin)
+            preds = self.server.batcher.submit(X, output_margin=output_margin,
+                                               deadline=dl)
         except QueueFull as e:
             _st(503)
             self._send_json(503, {"error": str(e)})
+            return
+        except DeadlineExceeded as e:
+            # expired in the queue (dropped pre-dispatch) or while
+            # waiting: no result exists and none was paid for
+            _st(504)
+            self._send_json(504, {"error": str(e),
+                                  "deadline_exceeded": True})
             return
         except ValueError as e:
             # deterministic client-input errors surfaced by the engine
@@ -337,6 +397,8 @@ class _Handler(BaseHTTPRequestHandler):
             _st(500)
             self._send_json(500, {"error": str(e)})
             return
+        ps.observe_service(int(X.shape[0]),
+                           time.perf_counter() - t_submit)
         # the version that actually PRODUCED these predictions (tagged
         # by the registry; reg.version may have moved during a reload)
         version = getattr(preds, "model_version", reg.version)
@@ -384,6 +446,10 @@ class _Handler(BaseHTTPRequestHandler):
         def _st(code: int) -> None:
             if sp is not None:
                 sp.set("status", code)
+        dl = Deadline.from_headers(self.headers)
+        if dl is not None and dl.expired():
+            self._deadline_reject("deadline expired on arrival", dl, sp)
+            return
         store = self._store()
         if store is None:
             _st(404)
@@ -520,6 +586,12 @@ class PredictServer:
         # store instead of feeding wrong-width rows to the new engine
         self.featurestore = featurestore
         self._fs_lock = threading.Lock()
+        # per-row-bucket EWMA of observed predict service time (submit
+        # -> result), feeding admission-by-deadline: a request whose
+        # remaining budget is below its bucket's estimate is 504'd
+        # before any device work (reliability/deadline.py)
+        self._svc_lock = threading.Lock()
+        self._svc_ewma: dict = {}
         # fleet membership (attach_fleet): registration/heartbeat lease
         # client against a fleet router; None = standalone replica
         self.lease_client = None
@@ -578,6 +650,57 @@ class PredictServer:
                 self.featurestore = store
                 featurestore_metrics().resident_bytes.set(0)
         return store
+
+    # ---------------------------------------------------- service estimate
+    @staticmethod
+    def _svc_bucket(rows: int) -> int:
+        """Power-of-two row bucket for the service-time EWMA — mirrors
+        the engine's shape-bucket ladder without coupling to it."""
+        b = 1
+        while b < rows:
+            b <<= 1
+        return b
+
+    def observe_service(self, rows: int, seconds: float) -> None:
+        """Fold one completed predict into its bucket's service-time
+        EWMA (alpha 0.2: stable against one slow batch, responsive to a
+        real shift)."""
+        key = self._svc_bucket(max(1, int(rows)))
+        with self._svc_lock:
+            prev = self._svc_ewma.get(key)
+            self._svc_ewma[key] = (seconds if prev is None
+                                   else 0.8 * prev + 0.2 * seconds)
+
+    def service_estimate(self, rows: int) -> float:
+        """Expected service seconds for a request of ``rows`` rows
+        (its bucket's EWMA, or — when its bucket has no samples — the
+        largest EWMA among smaller buckets as a floor).  0.0 = no
+        observations yet — admission stays open until the estimate
+        exists, so a cold replica never rejects."""
+        key = self._svc_bucket(max(1, int(rows)))
+        with self._svc_lock:
+            if key in self._svc_ewma:
+                return self._svc_ewma[key]
+            smaller = [v for k, v in self._svc_ewma.items() if k < key]
+        return max(smaller) if smaller else 0.0
+
+    def decay_service(self, rows: int, factor: float = 0.95) -> None:
+        """Walk an estimate down on every admission rejection it
+        causes: rejections produce no completions, so without this a
+        backlog-inflated estimate above every caller's budget would
+        latch the bucket into rejecting forever.  Decays the bucket
+        that actually SUPPLIED the estimate — the request's own, or
+        the smaller bucket whose EWMA served as its floor (decaying
+        only the absent request bucket would be a no-op and the latch
+        would stand)."""
+        key = self._svc_bucket(max(1, int(rows)))
+        with self._svc_lock:
+            if key not in self._svc_ewma:
+                smaller = [k for k in self._svc_ewma if k < key]
+                if not smaller:
+                    return
+                key = max(smaller, key=lambda k: self._svc_ewma[k])
+            self._svc_ewma[key] *= factor
 
     # -------------------------------------------------------------- fleet
     def attach_fleet(self, router_url: str,

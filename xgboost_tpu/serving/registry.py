@@ -320,7 +320,10 @@ class ModelRegistry:
         self._poller.start()
 
     def _poll_loop(self) -> None:
-        while not self._stop.wait(self.poll_sec):
+        from xgboost_tpu.reliability.deadline import jittered
+        # ±20% jitter: a fleet of replicas watching the same published
+        # model file must not stat it in lockstep every poll tick
+        while not self._stop.wait(jittered(self.poll_sec)):
             try:
                 self.check_reload()
             except Exception as e:  # the poller must survive anything
