@@ -38,10 +38,18 @@ chunked tree-parallel traversal cost are pinned separately); the
 kernel paths; ``extmem`` forces the over-budget STREAMING
 external-memory path and reports rounds/s + staged MB/s.
 
+Round 8 adds the ``fusion`` workload: segmented round fusion A/B —
+per-round dispatch (``rounds_per_dispatch=0``, the same switch
+``XGBTPU_ROUNDS_PER_DISPATCH=0`` flips) vs fused segments
+K ∈ {1, 4, 16, 64} WITH a configured watchlist (the exact shape the
+CLI gate used to force onto the per-round path), plus the eval-free
+fused rate at K=16 so the device-resident eval's cost is
+driver-visible (``fusion_watchlist_vs_noeval_k16``).
+
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
 "multiclass_ms_per_round", "rank_rounds_per_sec", ...}.
 ``BENCH_WORKLOADS`` (comma list of binary,multiclass,rank,otto,
-yearpred,extmem) trims it.
+yearpred,extmem,fusion) trims it.
 """
 
 import json
@@ -283,6 +291,57 @@ def bench_extmem():
     return rps, staged_mb * rps, float(auc)
 
 
+def bench_fusion():
+    """Segmented round fusion A/B (round 8): rounds/s of the per-round
+    baseline (K=0) vs fused segments K ∈ {1, 4, 16, 64}, all WITH a
+    watchlist (held-out eval set + train-as-eval, auc) — the workload
+    shape that rode the per-round path before the segmented driver.
+    ``noeval_k16`` times the eval-free fused path so the device-resident
+    eval's cost is pinned: the round-8 gate is watchlist rounds/s at
+    K=16 within 15% of it.  Returns a flat field dict."""
+    import xgboost_tpu as xgb
+
+    n = int(os.environ.get("BENCH_FUSION_ROWS",
+                           os.environ.get("BENCH_ROWS", 1_000_000)))
+    # rounds-1 timed rounds; 65 makes the K=64 cell one full segment
+    rounds = int(os.environ.get("BENCH_FUSION_ROUNDS", 65))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    X, y = make_higgs_like(n + n // 10 + 1)
+    d = xgb.DMatrix(X[:n], label=y[:n])
+    dval = xgb.DMatrix(X[n:], label=y[n:])
+    params = {"objective": "binary:logistic", "max_depth": 6,
+              "eta": 0.1, "max_bin": 64, "eval_metric": "auc"}
+
+    def time_cfg(k, with_eval):
+        evals = [(dval, "eval"), (d, "train")] if with_eval else None
+        dt = float("inf")
+        for rep in range(reps + 1):               # rep 0 pays compilation
+            bst = xgb.Booster(params, cache=[d, dval])
+            bst.update(d, 0)
+            _barrier_entry(bst, d)
+            t0 = time.perf_counter()
+            bst.update_many(d, 1, rounds - 1, evals=evals,
+                            rounds_per_dispatch=k)
+            _barrier_entry(bst, d)
+            if rep:
+                dt = min(dt, time.perf_counter() - t0)
+        return (rounds - 1) / dt
+
+    out = {}
+    for k in (0, 1, 4, 16, 64):
+        out[f"fusion_eval_rounds_per_sec_k{k}"] = round(
+            time_cfg(k, True), 3)
+    out["fusion_noeval_rounds_per_sec_k16"] = round(time_cfg(16, False), 3)
+    out["fusion_watchlist_vs_noeval_k16"] = round(
+        out["fusion_eval_rounds_per_sec_k16"]
+        / out["fusion_noeval_rounds_per_sec_k16"], 4)
+    out["fusion_speedup_k16_vs_per_round"] = round(
+        out["fusion_eval_rounds_per_sec_k16"]
+        / out["fusion_eval_rounds_per_sec_k0"], 4)
+    out["fusion_rows"] = n
+    return out
+
+
 def bench_rank():
     """rank:ndcg, 1M rows in 10k groups of 100, depth 6 (demo/rank
     shape scaled up; exercises the fused on-device LambdaRank).
@@ -326,7 +385,7 @@ def main():
     n_rounds = int(os.environ.get("BENCH_ROUNDS", 100))
     workloads = [w.strip() for w in os.environ.get(
         "BENCH_WORKLOADS",
-        "binary,multiclass,rank,otto,yearpred,extmem").split(",")]
+        "binary,multiclass,rank,otto,yearpred,extmem,fusion").split(",")]
     import xgboost_tpu as xgb
     from xgboost_tpu import metrics
 
@@ -406,6 +465,8 @@ def main():
         out["extmem_stream_rounds_per_sec"] = round(ex_rps, 3)
         out["extmem_staged_mb_per_sec"] = round(ex_mbs, 1)
         out["extmem_auc"] = round(ex_auc, 4)
+    if "fusion" in workloads:
+        out.update(bench_fusion())
     print(json.dumps(out))
 
 

@@ -86,10 +86,11 @@ def test_cli_save_period_and_checkpoint_resume(svm_data, tmp_path):
     assert cli_main([conf]) == 0
     assert os.path.exists(tp / "0002.model")
     assert os.path.exists(tp / "0004.model")
-    # newest two checkpoints kept
+    # newest two checkpoints kept; checkpoints land at fused segment
+    # boundaries (boundary_align=save_period=2 -> rounds 2 and 4)
     # the persistent jit cache lives alongside the ring (RECOVERY.md)
     kept = sorted(f for f in os.listdir(ckpt) if f.startswith("ckpt-"))
-    assert kept == ["ckpt-000003.model", "ckpt-000004.model"]
+    assert kept == ["ckpt-000002.model", "ckpt-000004.model"]
 
     # "kill" after round 4 of 6: rerun with num_round=6 resumes from ckpt 4
     conf6 = _conf(tp, train, test, num_round="6", checkpoint_dir=ckpt,

@@ -192,19 +192,23 @@ def test_mid_train_kill_resumes_bit_identical(tmp_path):
     finishes BIT-identical to an uninterrupted cycle (deterministic
     data source + continued iteration numbering)."""
     src = lambda: SyntheticDataSource(n_rows=300, n_features=6, seed=0)
-    # reference: two uninterrupted cycles
+    # reference: two uninterrupted cycles (default fused segment size)
     ref_pub = tmp_path / "ref.model"
     ref = make_trainer(tmp_path / "ref_wd", ref_pub, rounds=4,
                        source=src())
     assert ref.run_cycle()["status"] == "published"
     assert ref.run_cycle()["status"] == "published"
 
+    # the interrupted trainer fuses 2 rounds per dispatch so the ring
+    # gets a mid-cycle boundary write (ckpt-000002) before the kill
+    seg_params = dict(PARAMS, rounds_per_dispatch=2)
     pub = tmp_path / "published.model"
-    tr = make_trainer(tmp_path / "wd", pub, rounds=4, source=src())
+    tr = make_trainer(tmp_path / "wd", pub, rounds=4, source=src(),
+                      params=seg_params)
     assert tr.run_cycle()["status"] == "published"
-    # "kill" cycle 1 mid-train: the 3rd checkpoint write dies, having
-    # appended 2 rounds to the ring
-    faults.inject("enospc", path_sub="ckpt-000003")
+    # "kill" cycle 1 mid-train: the second segment's boundary write
+    # dies, leaving 2 rounds in the ring
+    faults.inject("enospc", path_sub="ckpt-000004")
     summary = tr.run(cycles=1)
     assert summary["errors"] == 1
     assert tr._read_state() == {"cycle": 1, "phase": "train"}

@@ -1,0 +1,176 @@
+"""Segmented round fusion (Booster.update_many driver): K rounds per
+dispatch must be BIT-identical to the per-round path — model bytes,
+margins, and eval-line text — at every segment size, including sizes
+that do not divide the round count, warm starts, and mid-segment
+checkpoint resume."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import xgboost_tpu as xgb  # noqa: E402
+from xgboost_tpu.learner import Booster  # noqa: E402
+
+
+def make_data(n=1500, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = ((X[:, 0] + 0.3 * X[:, 1] > 0.6) ^ (X[:, 2] > 0.7)).astype(
+        np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.4}
+
+
+def _run(params, n_rounds, k, evals_names=("eval", "train"),
+         seed_data=0, init_model=None, n=1500):
+    """Train with segment size ``k`` (0 = per-round baseline) and return
+    (booster, eval_lines, dtrain)."""
+    X, y = make_data(n=n, seed=seed_data)
+    Xe, ye = make_data(n=500, seed=seed_data + 100)
+    dtrain = xgb.DMatrix(X, label=y)
+    deval = xgb.DMatrix(Xe, label=ye)
+    named = {"train": dtrain, "eval": deval}
+    evals = [(named[nm], nm) for nm in evals_names]
+    bst = Booster(params, cache=[dtrain, deval], model_file=init_model)
+    first = bst.gbtree.num_boosted_rounds if bst.gbtree is not None else 0
+    lines = []
+    bst.update_many(dtrain, first, n_rounds, evals=evals or None,
+                    eval_callback=lambda i, msg: lines.append(msg),
+                    rounds_per_dispatch=k)
+    return bst, lines, dtrain
+
+
+def _assert_bitwise_equal(ba, la, bb, lb, d):
+    assert la == lb                       # eval-line TEXT, not approx
+    np.testing.assert_array_equal(np.asarray(ba.predict(d)),
+                                  np.asarray(bb.predict(d)))
+    assert bytes(ba.save_raw()) == bytes(bb.save_raw())
+
+
+@pytest.mark.parametrize("k", [1, 3, 4, 64])
+def test_segmented_bit_parity_vs_per_round(k):
+    """K ∈ {divides, does-not-divide, exceeds} 7 rounds: model bytes,
+    margins and eval lines all byte-match the per-round baseline."""
+    params = {**PARAMS, "eval_metric": "logloss"}
+    b0, l0, d = _run(params, 7, 0)
+    bk, lk, _ = _run(params, 7, k)
+    assert len(lk) == 7 and lk[0].startswith("[0]")
+    _assert_bitwise_equal(b0, l0, bk, lk, d)
+
+
+def test_warm_start_subsample_bit_parity(tmp_path):
+    """init_model continuation with subsampling: the fused path must
+    replay the same fold_in(seed, iteration) keys from the warm-start
+    offset, not restart the key schedule."""
+    params = {**PARAMS, "subsample": 0.7, "colsample_bytree": 0.8,
+              "seed": 11, "eval_metric": "error"}
+    base, _, _ = _run(params, 3, 0)
+    mf = str(tmp_path / "warm.model")
+    base.save_model(mf)
+    b0, l0, d = _run(params, 5, 0, init_model=mf)
+    b4, l4, _ = _run(params, 5, 4, init_model=mf)
+    assert l4[0].startswith("[3]") and l4[-1].startswith("[7]")
+    _assert_bitwise_equal(b0, l0, b4, l4, d)
+
+
+def test_checkpoint_resume_mid_segment(tmp_path):
+    """Kill-at-a-segment-boundary resume: bytes captured by the
+    segment_callback restore a booster that finishes bit-identical to
+    the uninterrupted run (deterministic per-iteration seeding)."""
+    X, y = make_data()
+    params = {**PARAMS, "subsample": 0.8, "seed": 5}
+
+    d_ref = xgb.DMatrix(X, label=y)
+    ref = Booster(params, cache=[d_ref])
+    ref.update_many(d_ref, 0, 10, rounds_per_dispatch=4)
+
+    # interrupted run: segments of 4 -> boundaries after rounds 4, 8;
+    # capture the ring write at round 8 and stop there (mid final
+    # segment of the 10-round plan)
+    snaps = {}
+    d1 = xgb.DMatrix(X, label=y)
+    b1 = Booster(params, cache=[d1])
+
+    def seg_cb(last_i):
+        snaps[last_i + 1] = bytes(b1.save_raw())
+
+    b1.update_many(d1, 0, 8, segment_callback=seg_cb,
+                   rounds_per_dispatch=4)
+    assert sorted(snaps) == [4, 8]
+
+    d2 = xgb.DMatrix(X, label=y)
+    b2 = Booster(params, cache=[d2])
+    b2.load_raw(snaps[8])
+    assert b2.gbtree.num_boosted_rounds == 8
+    b2.update_many(d2, 8, 2, rounds_per_dispatch=4)
+    assert bytes(b2.save_raw()) == bytes(ref.save_raw())
+
+
+def test_watchlist_metrics_multiclass_multi_metric():
+    """Device-resident eval with several metrics and a train-as-eval
+    slot: line text matches the per-round path character for character."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(900, 6).astype(np.float32)
+    y = (X[:, 0] * 3).astype(np.int32).clip(0, 2).astype(np.float32)
+    params = {"objective": "multi:softprob", "num_class": 3,
+              "max_depth": 3, "eta": 0.3,
+              "eval_metric": ["merror", "mlogloss"]}
+    d0 = xgb.DMatrix(X, label=y)
+    b0 = Booster(params, cache=[d0])
+    l0 = []
+    b0.update_many(d0, 0, 5, evals=[(d0, "train")],
+                   eval_callback=lambda i, m: l0.append(m),
+                   rounds_per_dispatch=0)
+    d3 = xgb.DMatrix(X, label=y)
+    b3 = Booster(params, cache=[d3])
+    l3 = []
+    b3.update_many(d3, 0, 5, evals=[(d3, "train")],
+                   eval_callback=lambda i, m: l3.append(m),
+                   rounds_per_dispatch=3)
+    assert l0 == l3
+    assert "train-merror" in l3[0] and "train-mlogloss" in l3[0]
+    assert bytes(b0.save_raw()) == bytes(b3.save_raw())
+
+
+def test_env_override_forces_per_round(monkeypatch):
+    """XGBTPU_ROUNDS_PER_DISPATCH=0 is the A/B switch: it beats both the
+    param and the call-site override, and the plan reports k=0."""
+    monkeypatch.setenv("XGBTPU_ROUNDS_PER_DISPATCH", "0")
+    X, y = make_data(n=400)
+    d = xgb.DMatrix(X, label=y)
+    bst = Booster({**PARAMS, "rounds_per_dispatch": 8}, cache=[d])
+    plans = []
+    bst.update_many(d, 0, 3, plan_callback=plans.append,
+                    rounds_per_dispatch=16)
+    assert plans == [0]
+    assert bst.gbtree.num_trees == 3
+
+
+def test_auto_plan_from_round_model():
+    """rounds_per_dispatch=-1 (the default) sizes segments from the
+    fitted round model: some k in [1, 64], reported once via
+    plan_callback."""
+    X, y = make_data(n=400)
+    d = xgb.DMatrix(X, label=y)
+    bst = Booster(PARAMS, cache=[d])
+    plans = []
+    bst.update_many(d, 0, 2, plan_callback=plans.append)
+    assert len(plans) == 1 and 1 <= plans[0] <= 64
+    assert bst.gbtree.num_trees == 2
+
+
+def test_segment_compile_budget(recompile_guard):
+    """The fused scan compiles once per DISTINCT segment length and its
+    statics are instance-independent: a second 10-round K=3 run (segment
+    lengths {3, 1}, eval included) with a FRESH booster and fresh
+    matrices compiles zero XLA programs.  (Tree-count-dependent host
+    stack concatenates — shared with the per-round path — are the only
+    shape-varying programs, so the round count must match across the
+    warm and guarded runs.)"""
+    params = {**PARAMS, "eval_metric": "logloss"}
+    _run(params, 10, 3)         # warm: segment lengths {3, 1} + eval
+    with recompile_guard.expect(0):
+        _run(params, 10, 3)     # fresh booster, same shapes -> no XLA

@@ -37,6 +37,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
+def _sweep(B, xgb, params, X, y, rows_list, rounds, tag):
+    points = []
+    for n in rows_list:
+        d = xgb.DMatrix(X[:n], label=y[:n])
+        t0 = time.perf_counter()
+        dt, _ = B._time_training(xgb, params, d, rounds)
+        s_round = dt / (rounds - 1)
+        points.append({"rows": n, "s_per_round": s_round})
+        print(f"[{tag}] rows={n:>9,}  {s_round*1e3:7.3f} ms/round  "
+              f"({1/s_round:6.1f} r/s; wall {time.perf_counter()-t0:.0f}s)",
+              file=sys.stderr)
+    rows = np.array([p["rows"] for p in points], np.float64)
+    t = np.array([p["s_per_round"] for p in points], np.float64)
+    A = np.stack([np.ones_like(rows), rows], axis=1)
+    (fixed, slope), res, *_ = np.linalg.lstsq(A, t, rcond=None)
+    pred = A @ np.array([fixed, slope])
+    rel_err = np.abs(pred - t) / t
+    return float(fixed), float(slope), points, float(rel_err.max())
+
+
 def main():
     import bench as B
     import xgboost_tpu as xgb
@@ -49,35 +69,47 @@ def main():
               "eta": 0.1, "max_bin": 64}
 
     X, y = B.make_higgs_like(max(rows_list))
-    points = []
-    for n in rows_list:
-        d = xgb.DMatrix(X[:n], label=y[:n])
-        t0 = time.perf_counter()
-        dt, _ = B._time_training(xgb, params, d, rounds)
-        s_round = dt / (rounds - 1)
-        points.append({"rows": n, "s_per_round": s_round})
-        print(f"rows={n:>9,}  {s_round*1e3:7.3f} ms/round  "
-              f"({1/s_round:6.1f} r/s; wall {time.perf_counter()-t0:.0f}s)",
-              file=sys.stderr)
+    fixed, slope, points, max_rel = _sweep(
+        B, xgb, params, X, y, rows_list, rounds, "fused")
 
-    rows = np.array([p["rows"] for p in points], np.float64)
-    t = np.array([p["s_per_round"] for p in points], np.float64)
-    A = np.stack([np.ones_like(rows), rows], axis=1)
-    (fixed, slope), res, *_ = np.linalg.lstsq(A, t, rcond=None)
-    pred = A @ np.array([fixed, slope])
-    rel_err = np.abs(pred - t) / t
+    # round 8: the primary sweep rides update_many's segmented fusion
+    # (auto-K, or XGBTPU_ROUNDS_PER_DISPATCH in the env); a second
+    # sweep at K=0 measures the per-round dispatch floor the fusion
+    # removes, so the json carries the A/B the PROFILE quotes.
+    # FIT_PER_ROUND_BASELINE=0 skips it.
+    baseline = None
+    if os.environ.get("FIT_PER_ROUND_BASELINE", "1") != "0":
+        old = os.environ.get("XGBTPU_ROUNDS_PER_DISPATCH")
+        os.environ["XGBTPU_ROUNDS_PER_DISPATCH"] = "0"
+        try:
+            bfixed, bslope, bpoints, bmax_rel = _sweep(
+                B, xgb, params, X, y, rows_list, rounds, "per-round")
+        finally:
+            if old is None:
+                os.environ.pop("XGBTPU_ROUNDS_PER_DISPATCH", None)
+            else:
+                os.environ["XGBTPU_ROUNDS_PER_DISPATCH"] = old
+        baseline = {"fixed_round_s": bfixed, "per_row_s": bslope,
+                    "points": bpoints, "fit_max_rel_err": bmax_rel,
+                    "fixed_drop_vs_fused": (bfixed / fixed)
+                    if fixed > 0 else None}
+
     model = {
-        "fixed_round_s": float(fixed),
-        "per_row_s": float(slope),
+        "fixed_round_s": fixed,
+        "per_row_s": slope,
         "config": {"max_depth": 6, "n_feat": 28, "n_bin": 64,
                    "max_bin": 64, "eta": 0.1,
-                   "objective": "binary:logistic", "rounds": rounds},
+                   "objective": "binary:logistic", "rounds": rounds,
+                   "rounds_per_dispatch": os.environ.get(
+                       "XGBTPU_ROUNDS_PER_DISPATCH", "auto")},
         "points": points,
-        "fit_max_rel_err": float(rel_err.max()),
+        "fit_max_rel_err": max_rel,
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
         "fitted_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if baseline is not None:
+        model["per_round_baseline"] = baseline
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "ROUND_MODEL.json")
     with open(out, "w") as f:

@@ -10,11 +10,14 @@ file of ``name = value`` pairs plus command-line overrides, dispatching
 
 Fault tolerance: where the reference wraps the round loop in rabit
 checkpoints (``xgboost_main.cpp:175-229``, two versions per round), this
-driver checkpoints the model to ``checkpoint_dir`` after every round and
-resumes from the newest VERIFIABLE checkpoint on restart (SURVEY.md
-§5.3 TPU mapping: per-round model checkpoint + restartable loop keyed
-by round version; collectives themselves are not elastically
-recoverable mid-step under XLA).  Checkpoint writes are atomic +
+driver checkpoints the model to ``checkpoint_dir`` at every fused
+SEGMENT boundary (per round when fusion is ineligible or
+``rounds_per_dispatch=0``) and resumes from the newest VERIFIABLE
+checkpoint on restart (SURVEY.md §5.3 TPU mapping: model checkpoint +
+restartable loop keyed by round version; deterministic per-iteration
+seeding makes the re-trained tail bit-identical, so coarser write
+granularity trades only recompute, never correctness; collectives
+themselves are not elastically recoverable mid-step under XLA).  Checkpoint writes are atomic +
 CRC-footered, a corrupt newest member is quarantined and the older
 ring replica used instead (RELIABILITY.md), and ``faults=`` arms I/O
 chaos injection the way ``mock=`` arms collective-seam deaths.
@@ -421,25 +424,46 @@ class BoostLearnTask:
     # ------------------------------------------------------------- train
     def _train_rounds(self, bst, data, evals, start_round: int,
                       start: float) -> None:
-        """Per-round loop: eval lines, periodic saves, checkpoints
-        (reference TaskTrain round loop, xgboost_main.cpp:175-229)."""
-        for i in range(start_round, self.num_round):
+        """The training round driver (reference TaskTrain round loop,
+        xgboost_main.cpp:175-229), riding ``Booster.update_many``'s
+        segmented fused dispatches: eval lines and numbered saves keep
+        per-round granularity/bit-identity, checkpoints write at
+        segment boundaries (a mid-segment SIGKILL resumes from the last
+        boundary's ring member and retrains bit-identically — per-round
+        fold_in seeding).  Ineligible configs (mock faults, pruning,
+        external memory, profiler/obs phases, ...) and
+        rounds_per_dispatch=0 run the same hooks one round at a time."""
+
+        def plan_cb(k: int) -> None:
+            if self.silent or not k:
+                return
+            n = self.num_round - start_round
+            print(f"fusing rounds {start_round}..{self.num_round - 1} "
+                  f"in segments of {k} "
+                  f"({-(-n // k)} device dispatches)", file=sys.stderr)
+
+        def round_cb(i: int) -> None:
             if not self.silent:
                 print(f"boosting round {i}, "
                       f"{time.perf_counter() - start:.0f} sec "
                       "elapsed", file=sys.stderr)
-            bst.update(data, i)
-            if evals:
-                from contextlib import nullcontext
-                prof = bst.profiler
-                with prof.phase("eval") if prof else nullcontext():
-                    msg = bst.eval_set(evals, i)
-                if self.silent < 2:
-                    print(msg, file=sys.stderr)
-            if self.save_period != 0 and (i + 1) % self.save_period == 0:
-                self._save(bst, i)
+
+        def eval_cb(i: int, msg: str) -> None:
+            if self.silent < 2:
+                print(msg, file=sys.stderr)
+
+        def seg_cb(last_i: int) -> None:
+            if self.save_period != 0 \
+                    and (last_i + 1) % self.save_period == 0:
+                self._save(bst, last_i)
             if self.checkpoint_dir and self.rank == 0:
-                _save_checkpoint(self.checkpoint_dir, bst, i + 1)
+                _save_checkpoint(self.checkpoint_dir, bst, last_i + 1)
+
+        bst.update_many(data, start_round, self.num_round - start_round,
+                        evals=evals or None, plan_callback=plan_cb,
+                        round_callback=round_cb, eval_callback=eval_cb,
+                        segment_callback=seg_cb,
+                        boundary_align=self.save_period)
 
     def task_train(self) -> int:
         import xgboost_tpu  # noqa: F401  (ensure package import works early)
@@ -475,21 +499,12 @@ class BoostLearnTask:
                       "start)", file=sys.stderr)
 
         start = time.perf_counter()
-        # nothing runs on the host between rounds (no eval lines, no
-        # periodic saves, no per-round checkpoint): fuse the whole round
-        # loop into one device launch (update_many falls back per-round
-        # when ineligible — mock, pruning, external memory, ...)
-        if (not evals and self.save_period == 0
-                and not self.checkpoint_dir):
-            if not self.silent:
-                # the per-round progress lines don't exist in a fused
-                # launch; say so once (liveness signal for long jobs)
-                print(f"fusing rounds {start_round}..{self.num_round - 1} "
-                      "into one device launch", file=sys.stderr)
-            bst.update_many(data, start_round,
-                            self.num_round - start_round)
-        else:
-            self._train_rounds(bst, data, evals, start_round, start)
+        # every config drives the segmented fused dispatcher: eval
+        # lines, save_period and checkpoint_dir land at per-round /
+        # segment-boundary granularity WITHOUT forcing per-round device
+        # dispatches (update_many falls back per-round when fusion is
+        # ineligible — mock, pruning, external memory, profiler, ...)
+        self._train_rounds(bst, data, evals, start_round, start)
         # save final round unless a periodic numbered save already covered
         # it (reference xgboost_main.cpp:219-225: no final save when
         # save_period divides num_round, even with model_out set)

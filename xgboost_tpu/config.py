@@ -116,6 +116,16 @@ class TrainParam:
     # >1 = force that chunk width.  XGBTPU_PREDICT_TREE_CHUNK env
     # overrides for A/Bs.
     predict_tree_chunk: int = -1
+    # segmented round fusion (learner.update_many): how many boosting
+    # rounds run per fused _scan_rounds dispatch — the host is touched
+    # only at segment boundaries (eval lines, periodic saves and
+    # checkpoints all still land per round / per boundary, bit-identical
+    # to the per-round path).  -1 auto = choose from the fitted round
+    # model (ROUND_MODEL.json: segment long enough that the fixed
+    # per-dispatch cost is <=10% of the dispatch, clamped to [1, 64]);
+    # 0 = per-round dispatch (the A/B baseline); >0 = that segment
+    # size.  XGBTPU_ROUNDS_PER_DISPATCH env overrides for A/Bs.
+    rounds_per_dispatch: int = -1
     # multi-root trees (reference TreeParam::num_roots, tree/param.h):
     # rows enter the tree at per-row roots given by the root_index meta
     # field (data.h:39-58); trees reserve ceil(log2 num_roots) top levels

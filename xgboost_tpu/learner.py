@@ -859,16 +859,81 @@ class Booster:
             if prof and entry.margin is not None:
                 p.block(entry.margin)
 
+    def _resolve_rounds_per_dispatch(self, n_rows: int,
+                                     override=None) -> int:
+        """Segment size K for fused training dispatches.  Priority:
+        env ``XGBTPU_ROUNDS_PER_DISPATCH`` > explicit ``override`` >
+        the ``rounds_per_dispatch`` train param.  ``-1`` (auto) sizes
+        the segment from the fitted round model (ROUND_MODEL.json) so
+        the fixed per-dispatch cost amortizes to <=10% of the dispatch
+        — ``K >= 9 * fixed / (per_row * rows)`` — clamped to [1, 64]
+        (past 64 the fixed term is noise and longer segments only delay
+        eval lines / checkpoints).  ``0`` = per-round dispatch, the A/B
+        baseline."""
+        import math
+        env = os.environ.get("XGBTPU_ROUNDS_PER_DISPATCH")
+        if env not in (None, ""):
+            k = int(env)
+        elif override is not None:
+            k = int(override)
+        else:
+            k = int(self.param.rounds_per_dispatch)
+        if k >= 0:
+            return k
+        from xgboost_tpu.parallel.commcost import fitted_round_model
+        m = fitted_round_model() or {}
+        # baked defaults = the committed ROUND_MODEL.json fit, so auto
+        # still sizes sanely when the file is missing
+        fixed = float(m.get("fixed_round_s", 4.465e-3))
+        per_row = float(m.get("per_row_s", 9.974e-9))
+        per_round = per_row * max(1, int(n_rows))
+        if per_round <= 0.0 or fixed <= 0.0:
+            return 16
+        return max(1, min(64, math.ceil(9.0 * fixed / per_round)))
+
     def update_many(self, dtrain: DMatrix, first_iteration: int,
-                    n_rounds: int, fobj=None) -> None:
-        """Run ``n_rounds`` boosting rounds, fused into ONE device launch
-        when nothing needs the host between rounds (no eval, no pruning,
-        no refresh, no fault injection, no custom/rank objective, no
-        column split, no profiler, in-memory gbtree).  Falls back to
-        per-round :meth:`update` otherwise.  The fused path bit-matches
-        the sequential path (same per-round keys and kernels) — the
-        reference's round loop is host-side by construction
-        (xgboost_main.cpp:183-217); here it compiles into the program.
+                    n_rounds: int, fobj=None, *, evals=None, feval=None,
+                    eval_callback=None, round_callback=None,
+                    segment_callback=None, plan_callback=None,
+                    boundary_align: int = 0,
+                    rounds_per_dispatch=None) -> None:
+        """Run ``n_rounds`` boosting rounds in fused SEGMENTS: K rounds
+        per ``_scan_rounds`` dispatch (``rounds_per_dispatch``; auto
+        sizes K from the fitted round model), touching the host only at
+        segment boundaries.  Watchlist evaluation runs device-resident
+        inside the scan — eval lines print per round AFTER the segment's
+        dispatch, byte-identical to the per-round path's — and the
+        stacked per-round trees each dispatch returns keep checkpoint
+        granularity at segment boundaries with per-round model bytes
+        available.  The fused path bit-matches the sequential path
+        (same per-round keys and kernels) — the reference's round loop
+        is host-side by construction (xgboost_main.cpp:183-217); here
+        it compiles into the program.
+
+        Falls back to per-round :meth:`update` (same callbacks, one
+        boundary per round) when fusion is ineligible — custom/host
+        objective, pruning, refresh, fault injection, column split,
+        profiler/obs phases, external-memory or sharded matrices — or
+        when the resolved segment size is 0 (the per-round A/B
+        baseline).
+
+        Driver hooks (all optional; the CLI and ContinuousTrainer ride
+        these instead of owning round loops):
+
+        - ``evals``/``feval``: watchlist ``[(dmat, name), ...]`` and
+          custom metric — eval lines are built per round on BOTH paths.
+        - ``eval_callback(iteration, msg)``: one formatted eval line.
+        - ``round_callback(iteration)``: per-round liveness, ONLY on
+          the per-round path (a fused segment has no between-round
+          host point by design).
+        - ``segment_callback(last_iteration)``: a segment completed
+          through ``last_iteration`` (per-round path: every round) —
+          checkpoint/save hook.
+        - ``plan_callback(k)``: the resolved segment size (0 =
+          per-round), reported once before training.
+        - ``boundary_align``: force segment boundaries at iteration
+          multiples (periodic ``save_period`` saves need the model
+          materialized exactly there).
         """
         from xgboost_tpu.models.updaters import parse_updaters
         from xgboost_tpu.parallel import mock
@@ -877,12 +942,16 @@ class Booster:
         entry = self._entry(dtrain)
         self._announce_rank_path(entry)
         ups = parse_updaters(self.param.updater)
+        evals = list(evals) if evals else []
 
         def fgrad():
             if entry.rank_pad_prep is not None:
                 return self.obj.fused_grad(entry.info,
                                            pad_prep=entry.rank_pad_prep)
             return self.obj.fused_grad(entry.info)
+        # device-resident eval needs every watchlist margin to live in
+        # the scan carry: sharded sets reduce metric partials across
+        # processes and external sets page batches — both per-round
         fused_ok = (
             fobj is None
             and n_rounds > 1
@@ -899,20 +968,86 @@ class Booster:
             and not getattr(self.gbtree, "exact_raw", False)
             and "refresh" not in ups
             and any(u.startswith("grow") for u in ups)
-            and fgrad() is not None)
-        if not fused_ok:
+            and fgrad() is not None
+            and all(not getattr(d, "is_sharded", False)
+                    and not self._entry(d).external for d, _ in evals))
+        k = (self._resolve_rounds_per_dispatch(
+            dtrain.num_row, rounds_per_dispatch) if fused_ok else 0)
+        if plan_callback is not None:
+            plan_callback(k)
+        if not fused_ok or k <= 0:
+            from contextlib import nullcontext
             for i in range(first_iteration, first_iteration + n_rounds):
+                if round_callback is not None:
+                    round_callback(i)
                 self.update(dtrain, i, fobj)
+                if evals:
+                    prof = self.profiler
+                    with prof.phase("eval") if prof else nullcontext():
+                        msg = self.eval_set(evals, i, feval)
+                    if eval_callback is not None:
+                        eval_callback(i, msg)
+                if segment_callback is not None:
+                    segment_callback(i)
             return
         self.obj.validate_labels(entry.info)  # host check, once per info
         self._sync_margin(entry)
-        entry.margin = self.gbtree.do_boost_fused(
-            entry.binned, entry.margin, entry.info,
-            fgrad(),
-            first_iteration, n_rounds, row_valid=entry.row_valid,
-            mesh=self._mesh,
-            binned_t=getattr(entry, "binned_t", None))
-        entry.applied = self.gbtree.num_trees
+        # (entry, is_train) per watchlist slot: a slot that IS the
+        # training matrix reads the scan's grow-time margin (the
+        # prediction-buffer shortcut) instead of carrying a second copy
+        espec = []
+        for dmat, name in evals:
+            e = self._entry(dmat)
+            if e is not entry:
+                self._sync_margin(e)
+            espec.append((dmat, name, e, e is entry))
+        etransform = self.obj.fused_eval_transform() if espec else None
+        align = max(0, int(boundary_align))
+        done = 0
+        while done < n_rounds:
+            first = first_iteration + done
+            seg = min(k, n_rounds - done)
+            if align:
+                # stop at the next aligned boundary so periodic saves
+                # see the model at exactly that round (segment lengths
+                # stay O(distinct) -> bounded scan compiles)
+                seg = min(seg, align - first % align)
+            margin_f, emargins_f, eouts = self.gbtree.do_boost_fused(
+                entry.binned, entry.margin, entry.info, fgrad(),
+                first, seg, row_valid=entry.row_valid, mesh=self._mesh,
+                binned_t=getattr(entry, "binned_t", None),
+                eval_binned=tuple(e.binned for _, _, e, t in espec
+                                  if not t),
+                eval_margins=tuple(e.margin for _, _, e, t in espec
+                                   if not t),
+                eval_is_train=tuple(t for _, _, _, t in espec),
+                etransform=etransform)
+            entry.margin = margin_f
+            entry.applied = self.gbtree.num_trees
+            ei = 0
+            for _, _, e, is_train in espec:
+                if is_train:
+                    continue
+                e.margin = emargins_f[ei]
+                e.applied = self.gbtree.num_trees
+                ei += 1
+            if espec:
+                # eval lines for every round of the segment, from the
+                # ONE dispatch's stacked outputs
+                from xgboost_tpu.obs import training_metrics
+                for r in range(seg):
+                    parts = [f"[{first + r}]"]
+                    for si, (dmat, name, e, _) in enumerate(espec):
+                        tr = e.user_rows(np.asarray(self._replicated(
+                            eouts[si][r])))
+                        self._eval_parts(dmat, name, tr, parts, feval)
+                    msg = "\t".join(parts)
+                    training_metrics().observe_eval(_parse_eval(msg))
+                    if eval_callback is not None:
+                        eval_callback(first + r, msg)
+            done += seg
+            if segment_callback is not None:
+                segment_callback(first + seg - 1)
 
     def boost(self, dtrain: DMatrix, grad, hess):
         """Boost from user-supplied gradients (reference
@@ -1354,6 +1489,33 @@ class Booster:
             names = [self.obj.default_metric]
         return [create_metric(n) for n in names]
 
+    def _eval_parts(self, dmat, name: str, tr, parts: List[str],
+                    feval) -> None:
+        """Append one watchlist set's ``name-metric:value`` fields to
+        ``parts`` from its transformed predictions ``tr`` (user rows,
+        host numpy) — shared by the per-round eval path (:meth:`eval_set`)
+        and the segmented fused driver (:meth:`update_many`), which
+        computes a ``tr`` per round of a segment from ONE stacked
+        dispatch output.  Same host float64 metric math on the same f32
+        values -> byte-identical eval text on both paths."""
+        labels = np.asarray(dmat.get_label())
+        weights = np.asarray(dmat.get_weight())
+        gptr = dmat.info.group_ptr
+        for m in self._metrics(feval):
+            p = tr if tr.shape[1] > 1 else tr[:, 0]
+            if getattr(m, "needs_fold_index", False):
+                val = m(p, labels, weights, gptr,
+                        fold_index=dmat.info.fold_index)
+            else:
+                val = m(p, labels, weights, gptr)
+            parts.append(f"{name}-{m.metric_name}:{val:.6f}")
+        if feval is not None:
+            # feval comes LAST so early stopping tracks it (reference
+            # wrapper/xgboost.py appends custom eval after built-ins)
+            preds = tr[:, 0] if tr.shape[1] == 1 else tr
+            mname, val = feval(preds, dmat)
+            parts.append(f"{name}-{mname}:{val:.6f}")
+
     def eval_set(self, evals: Sequence[Tuple[DMatrix, str]], iteration: int = 0,
                  feval=None) -> str:
         """Formatted eval line (reference EvalSet::Eval, evaluation.h:62-95:
@@ -1367,23 +1529,7 @@ class Booster:
                 continue
             tr = entry.user_rows(np.asarray(self._replicated(
                 self.obj.eval_transform(entry.margin))))
-            labels = np.asarray(dmat.get_label())
-            weights = np.asarray(dmat.get_weight())
-            gptr = dmat.info.group_ptr
-            for m in self._metrics(feval):
-                p = tr if tr.shape[1] > 1 else tr[:, 0]
-                if getattr(m, "needs_fold_index", False):
-                    val = m(p, labels, weights, gptr,
-                            fold_index=dmat.info.fold_index)
-                else:
-                    val = m(p, labels, weights, gptr)
-                parts.append(f"{name}-{m.metric_name}:{val:.6f}")
-            if feval is not None:
-                # feval comes LAST so early stopping tracks it (reference
-                # wrapper/xgboost.py appends custom eval after built-ins)
-                preds = tr[:, 0] if tr.shape[1] == 1 else tr
-                mname, val = feval(preds, dmat)
-                parts.append(f"{name}-{mname}:{val:.6f}")
+            self._eval_parts(dmat, name, tr, parts, feval)
         msg = "\t".join(parts)
         # latest eval scores ride the training metrics as gauges
         # (xgbtpu_training_eval_score{key="train-error"}), scrapeable

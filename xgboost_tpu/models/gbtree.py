@@ -81,20 +81,37 @@ def _unstack_trees(stacked, t: int):
     return tuple(jax.tree.map(lambda x: x[i], stacked) for i in range(t))
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "n_rounds", "K", "npar", "cfg", "split_finder", "grad_fn", "mesh"))
-def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
-                 cut_values, n_cuts, row_valid, binned_t=None, *,
-                 n_rounds: int, K: int,
-                 npar: int, cfg: GrowConfig, split_finder, grad_fn, mesh):
+def _scan_rounds_impl(binned, margin, label, weight, base_key,
+                      first_iteration, cut_values, n_cuts, row_valid,
+                      binned_t, eval_binned, eval_margins, *,
+                      n_rounds: int, K: int,
+                      npar: int, cfg: GrowConfig, split_finder, grad_fn,
+                      mesh, eval_is_train, etransform, pred_chunk: int):
     """``lax.scan`` over whole boosting rounds (one device launch for
     n_rounds x K x npar trees).  Module-level so the jit cache is shared
     across Booster instances: all static arguments (cfg, grad_fn,
-    split_finder) carry stable identities.
+    split_finder, etransform) carry stable identities.
 
-    Returns (final margin (N, K), stacked trees (n_rounds, K*npar, ...)).
+    Device-resident eval (segmented round fusion): ``eval_binned``
+    carries one binned matrix per non-train watchlist set and the
+    corresponding ``eval_margins`` ride the scan carry; each round adds
+    the round's tree contributions through the SAME
+    ``predict_margin_binned`` expression the per-round margin sync uses
+    (same ``pred_chunk``), then applies ``etransform``
+    (Objective.eval_transform) — so the per-round transformed outputs
+    the scan stacks are bit-identical to what the per-round eval path
+    would have pulled, with zero host dispatches between rounds.
+    ``eval_is_train`` marks watchlist slots that ARE the training
+    matrix: those read the grow-time margin directly (the per-round
+    path's prediction-buffer shortcut) instead of re-traversing.
+
+    Returns ``(final margin (N, K), final eval margins,
+    stacked trees (n_rounds, K*npar, ...),
+    per-round transformed eval outputs (one (n_rounds, N_e, K) per
+    watchlist slot))``.
     """
     T_pr = K * npar
+    group_pr = jnp.asarray([j // npar for j in range(T_pr)], jnp.int32)
 
     def grow_one(tkey, gh2):
         if mesh is not None:
@@ -112,7 +129,8 @@ def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
             d = d * row_valid.astype(d.dtype)
         return tree, d
 
-    def body(margin, i):
+    def body(carry, i):
+        margin, emargins = carry
         key = jax.random.fold_in(base_key, i)
         gh = grad_fn(margin, label, weight, i)           # (N, K, 2)
         if T_pr > 1:
@@ -128,13 +146,47 @@ def _scan_rounds(binned, margin, label, weight, base_key, first_iteration,
             delta = jnp.zeros_like(margin)
             for j in range(T_pr):
                 delta = delta.at[:, j // npar].add(ds[j])
-            return margin + delta, stacked
-        tree, d = grow_one(jax.random.fold_in(key, 0), gh[:, 0, :])
-        stacked = jax.tree.map(lambda x: x[None], tree)
-        return margin + d[:, None], stacked
+            margin = margin + delta
+        else:
+            tree, d = grow_one(jax.random.fold_in(key, 0), gh[:, 0, :])
+            stacked = jax.tree.map(lambda x: x[None], tree)
+            margin = margin + d[:, None]
+        eouts, new_em = [], []
+        ei = 0
+        for is_train in eval_is_train:
+            if is_train:
+                eouts.append(etransform(margin))
+                continue
+            em = (predict_margin_binned(
+                stacked, group_pr, eval_binned[ei],
+                jnp.zeros((), jnp.float32), cfg.max_depth, K,
+                root=None, n_roots=cfg.n_roots,
+                tree_chunk=pred_chunk) + emargins[ei])
+            new_em.append(em)
+            eouts.append(etransform(em))
+            ei += 1
+        return (margin, tuple(new_em)), (stacked, tuple(eouts))
 
     iters = first_iteration + jnp.arange(n_rounds)
-    return jax.lax.scan(body, margin, iters)
+    (margin, eval_margins), (stacks, eouts) = jax.lax.scan(
+        body, (margin, eval_margins), iters)
+    return margin, eval_margins, stacks, eouts
+
+
+# Two jit wrappings of ONE round-scan implementation: the donating
+# variant hands the margin (arg 1) and eval-margin (arg 11) carries'
+# buffers to XLA so segment k+1 updates segment k's output in place —
+# no per-segment device copy of the O(N*K) state.  CPU ignores donation
+# (with a UserWarning per call), so callers pick the wrapper by backend
+# (do_boost_fused; XGBTPU_FUSED_DONATE overrides for A/Bs).
+_SCAN_STATIC = ("n_rounds", "K", "npar", "cfg", "split_finder",
+                "grad_fn", "mesh", "eval_is_train", "etransform",
+                "pred_chunk")
+_scan_rounds = functools.partial(
+    jax.jit, static_argnames=_SCAN_STATIC)(_scan_rounds_impl)
+_scan_rounds_donated = functools.partial(
+    jax.jit, static_argnames=_SCAN_STATIC,
+    donate_argnums=(1, 11))(_scan_rounds_impl)
 
 
 class GBTree:
@@ -504,7 +556,9 @@ class GBTree:
     # ------------------------------------------------------------ fused boost
     def do_boost_fused(self, binned, margin, info, grad_fn,
                        first_iteration: int, n_rounds: int,
-                       row_valid=None, mesh=None, binned_t=None):
+                       row_valid=None, mesh=None, binned_t=None,
+                       eval_binned=(), eval_margins=(),
+                       eval_is_train=(), etransform=None, donate=None):
         """Scan ``n_rounds`` whole boosting rounds in ONE device launch.
 
         Per-round host dispatch (gradient launch + growth launch + margin
@@ -531,34 +585,62 @@ class GBTree:
             gradient with stable identity (Objective.fused_grad).
           row_valid: optional (N,) bool mask of real rows.
           mesh: optional data-parallel mesh (rows sharded over 'data').
+          eval_binned / eval_margins / eval_is_train / etransform:
+            device-resident watchlist evaluation (see
+            :func:`_scan_rounds_impl`) — per-round transformed eval
+            outputs come back stacked, one launch for the whole segment.
+          donate: donate the margin/eval-margin carries to XLA (None =
+            auto: on for non-CPU backends, where donation is honored;
+            env XGBTPU_FUSED_DONATE=0/1 overrides).
 
-        Returns the final (N, K) margin; grown trees are appended.
+        Returns ``(final margin (N, K), final eval margins tuple,
+        stacked per-round transformed eval outputs tuple)``; grown
+        trees are appended.
         """
         K = max(1, self.param.num_output_group)
         npar = max(1, self.param.num_parallel_tree)
         label = info.label_dev()
         weight = info.weight_dev(margin.shape[0])
+        if donate is None:
+            env = os.environ.get("XGBTPU_FUSED_DONATE")
+            if env not in (None, ""):
+                donate = env == "1"
+            else:
+                donate = jax.default_backend() != "cpu"
         # the fused scan still performs one logical histogram allreduce
         # per tree; keep the comm/seqno count space identical to the
         # per-round path (the injector is never armed here — fused
         # launches are ineligible while mock.active())
-        from xgboost_tpu.obs import comm
+        from xgboost_tpu.obs import comm, span, training_metrics
         from xgboost_tpu.parallel import mock
         comm_nbytes = self._comm_bytes(binned.shape[1], mesh)
         for r in range(n_rounds):
             mock.begin_round(first_iteration + r)
             for _ in range(K * npar):
                 mock.collective(nbytes=comm_nbytes)
-        _t_launch = time.perf_counter()
-        margin_f, stacks = _scan_rounds(
-            binned, margin, label, weight,
-            jax.random.PRNGKey(self.param.seed),
-            jnp.int32(first_iteration), self.cut_values_dev,
-            self.n_cuts_dev, row_valid, binned_t,
-            n_rounds=n_rounds, K=K, npar=npar, cfg=self.cfg,
-            split_finder=self._split_finder(), grad_fn=grad_fn, mesh=mesh)
-        comm.record("allreduce", count=0,
-                    seconds=time.perf_counter() - _t_launch)
+        scan = _scan_rounds_donated if donate else _scan_rounds
+        with span("train.dispatch", first_round=first_iteration,
+                  n_rounds=n_rounds, donated=bool(donate)):
+            _t_launch = time.perf_counter()
+            margin_f, emargins_f, stacks, eouts = scan(
+                binned, margin, label, weight,
+                jax.random.PRNGKey(self.param.seed),
+                jnp.int32(first_iteration), self.cut_values_dev,
+                self.n_cuts_dev, row_valid, binned_t,
+                tuple(eval_binned), tuple(eval_margins),
+                n_rounds=n_rounds, K=K, npar=npar, cfg=self.cfg,
+                split_finder=self._split_finder(), grad_fn=grad_fn,
+                mesh=mesh, eval_is_train=tuple(eval_is_train),
+                etransform=etransform, pred_chunk=self.pred_chunk)
+            # block at the segment boundary: the driver pulls eval lines
+            # / checkpoint bytes from this dispatch next, and the
+            # histogram must record device wall time, not async dispatch
+            jax.block_until_ready(margin_f)
+            _dt = time.perf_counter() - _t_launch
+        comm.record("allreduce", count=0, seconds=_dt)
+        tm = training_metrics()
+        tm.dispatch_seconds.observe(_dt)
+        tm.rounds_per_dispatch.set(float(n_rounds))
         # flatten (n_rounds, K*npar, ...) -> (T_new, ...) and install the
         # full-ensemble stack cache directly: prediction then reuses the
         # scan's own output instead of re-stacking T per-tree slices
@@ -589,7 +671,7 @@ class GBTree:
             self._pending = (flat, T_new)
         self.tree_group.extend(group_new)
         self._stack_cache = (self.num_trees, full, full_group)
-        return margin_f
+        return margin_f, emargins_f, eouts
 
     # ----------------------------------------------------------- paged boost
     def do_boost_paged(self, dmat, gh, key: jax.Array,

@@ -51,6 +51,14 @@ class Objective:
         """Transform used before metric evaluation (softprob for multiclass)."""
         return self.pred_transform(margin)
 
+    def fused_eval_transform(self):
+        """:meth:`eval_transform` as a pure function with STABLE
+        identity (jit static arg of the fused scan's device-resident
+        eval; same contract as :meth:`fused_grad` — a bound method
+        would hash by objective instance and recompile the scan for
+        every new booster)."""
+        return _identity_transform
+
     def prob_to_margin(self, base_score: float) -> float:
         return base_score
 
@@ -68,6 +76,14 @@ class Objective:
     def validate_labels(self, info) -> None:
         """Host-side label validation (once per info); shared by
         get_gradient and the fused path which bypasses it."""
+
+
+def _identity_transform(margin):
+    return margin
+
+
+def _softmax_transform(margin):
+    return jax.nn.softmax(margin, axis=1)
 
 
 @functools.lru_cache(maxsize=None)
@@ -149,6 +165,9 @@ class RegLossObj(Objective):
     def fused_grad(self, info=None):
         return _regloss_fused(self.loss, float(self.scale_pos_weight))
 
+    def fused_eval_transform(self):
+        return _sigmoid if self.transform_pred else _identity_transform
+
 
 @jax.jit
 def _softmax_grad(margin, label, weight):
@@ -204,6 +223,9 @@ class SoftmaxMultiClassObj(Objective):
 
     def fused_grad(self, info=None):
         return _softmax_fused
+
+    def fused_eval_transform(self):
+        return _softmax_transform
 
 
 def create_objective(name: str) -> Objective:

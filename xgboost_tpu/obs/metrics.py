@@ -451,10 +451,25 @@ class TrainingMetrics:
             f"{p}_device_memory_bytes",
             "bytes in use on local device 0 (0 when the backend does "
             "not report memory stats)")
+        # segmented round fusion (learner.update_many): one fused
+        # dispatch covers a SEGMENT of rounds, so round_seconds goes
+        # quiet on the fused path — these two carry the progress signal
+        # instead (note the xgbtpu_train_ family, not xgbtpu_training_:
+        # the dispatch is a device-launch unit, not a logical round)
+        self.dispatch_seconds = Histogram(
+            "xgbtpu_train_dispatch_seconds",
+            "wall time per fused training dispatch (one scan over a "
+            "segment of boosting rounds, device-blocked at the "
+            "segment boundary)", _ROUND_BUCKETS)
+        self.rounds_per_dispatch = Gauge(
+            "xgbtpu_train_rounds_per_dispatch",
+            "rounds covered by the most recent fused training dispatch "
+            "(segment size; stays 0 on the per-round path)")
         self._all = (self.rounds, self.round, self.round_seconds,
                      self.phase_seconds, self.eval_score,
                      self.checkpoints, self.checkpoint_seconds,
-                     self.device_memory)
+                     self.device_memory, self.dispatch_seconds,
+                     self.rounds_per_dispatch)
         registry().register("training", self.render)
 
     def observe_eval(self, scores: Dict[str, float]) -> None:

@@ -6,11 +6,14 @@ One cycle (PIPELINE.md has the full state machine and failure matrix):
    the CRC-verified load path (``Booster.load_model``); cold start
    trains from scratch when nothing is published yet.
 2. **train** — append ``rounds_per_cycle`` boosting rounds on the
-   cycle's fresh data (the :class:`~.datasource.DataSource` seam),
-   checkpointing every appended round into the same two-member
-   checkpoint ring the CLI uses — a SIGKILL mid-train resumes from the
-   ring and, because the data source is deterministic per cycle,
-   finishes bit-identical to an uninterrupted cycle.
+   cycle's fresh data (the :class:`~.datasource.DataSource` seam)
+   through the segmented fused driver (``Booster.update_many``:
+   ``rounds_per_dispatch`` rounds per device dispatch), checkpointing
+   at every segment boundary into the same two-member checkpoint ring
+   the CLI uses — a SIGKILL mid-train (even mid-SEGMENT) resumes from
+   the ring and, because the data source is deterministic per cycle
+   and seeding is per-iteration, finishes bit-identical to an
+   uninterrupted cycle.
 3. **gate** — verify the candidate file's CRC, then score candidate vs
    incumbent on the held-out window (:class:`~.gate.EvalGate`).  A
    failing (or corrupt) candidate is quarantined and the incumbent
@@ -201,15 +204,26 @@ class ContinuousTrainer:
                 self._say(f"cycle {cycle}: resumed mid-train at "
                           f"appended round {appended}")
         with span("pipeline.train", cycle=cycle, resumed=appended):
-            while appended < self.rounds_per_cycle:
+            if appended < self.rounds_per_cycle:
                 # iteration index continues the incumbent's numbering,
                 # so per-iteration seeding (fold_in) matches what one
                 # long uninterrupted training run would have used
-                it = (bst.gbtree.num_boosted_rounds
-                      if bst.gbtree is not None else 0)
-                bst.update(dtrain, it)
-                appended += 1
-                _save_checkpoint(self.ckpt_dir, bst, appended)
+                it0 = (bst.gbtree.num_boosted_rounds
+                       if bst.gbtree is not None else 0)
+                base = it0 - appended  # the incumbent's own rounds
+
+                def seg_cb(last_i: int) -> None:
+                    # ring checkpoint at every fused segment boundary
+                    # (per round when fusion is ineligible): a SIGKILL
+                    # inside a segment resumes from the last boundary
+                    # member and — deterministic per-iteration seeding —
+                    # retrains the lost tail bit-identically
+                    _save_checkpoint(self.ckpt_dir, bst,
+                                     last_i + 1 - base)
+
+                bst.update_many(dtrain, it0,
+                                self.rounds_per_cycle - appended,
+                                segment_callback=seg_cb)
             bst.save_model(self.candidate_path)  # atomic + CRC
         self._write_state({"cycle": cycle, "phase": "gate"})
         return self.candidate_path
