@@ -210,8 +210,10 @@ class TestRuleFixtures:
                "        x = allreduce(x)\n"
                "    return x\n")
         assert "XGT007" in codes(bad, path="xgboost_tpu/parallel/dp.py")
+        # the mesh-fused driver made learner.py a distributed seam too
+        assert "XGT007" in codes(bad, path="xgboost_tpu/learner.py")
         # scoped: same code outside the distributed seams is quiet
-        assert "XGT007" not in codes(bad, path="xgboost_tpu/learner.py")
+        assert "XGT007" not in codes(bad, path="xgboost_tpu/data.py")
         # every-rank collective with a rank branch around the DATA is
         # the documented fix
         ok = ("def sync(rank, x, y):\n"

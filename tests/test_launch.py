@@ -187,7 +187,11 @@ def test_stall_mock_watchdog_resume_bit_identical_composed_with_death(
     seqno 0, trial 0), the watchdog kills+restarts the gang, the
     restarted trial dies at (version 3, trial 1), keepalive restarts
     again, and the final model is BIT-identical to an uninterrupted
-    run."""
+    run.  ``rounds_per_dispatch=2`` keeps two fused segments in the
+    4-round job (mock replay no longer blocks fusion), so the stall
+    lands mid-segment-1 (no checkpoint yet) and the death lands after
+    the segment-boundary ring write — the second restart must resume
+    from round 2, not from scratch."""
     data = tmp_path / "train.libsvm"
     rng = np.random.RandomState(5)
     X = rng.rand(300, 5)
@@ -198,7 +202,7 @@ def test_stall_mock_watchdog_resume_bit_identical_composed_with_death(
             fh.write(f"{y[i]} {feats}\n")
     common = [f"data={data}", "task=train", "num_round=4", "silent=2",
               "objective=binary:logistic", "max_depth=3", "eta=0.5",
-              "max_bin=16"]
+              "max_bin=16", "rounds_per_dispatch=2"]
     ref = tmp_path / "ref.model"
     chaos = tmp_path / "chaos.model"
     env = _clean_env()

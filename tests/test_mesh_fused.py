@@ -1,0 +1,213 @@
+"""Mesh-fused training: the shard_map'd segmented round scan
+(``Booster.update_many`` -> ``_scan_rounds_mesh``).
+
+The contract under test is stronger than the per-round data-parallel
+path's: with ``hist_precision=fixed`` (int32 fixed-point histogram
+accumulation — exactly associative), the MODEL BYTES are bitwise
+invariant to the data-mesh device count, eval lines included, and the
+mesh-fused driver composes with checkpoints/warm starts and the gang
+launcher exactly like the single-device fused driver.
+
+Tests needing a live multi-device mesh gate on
+``mesh_available(min_devices=...)`` — tests/conftest.py forces 8
+virtual CPU devices in this container, so they run here; on a 1-device
+host they skip like the existing mesh tests.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import xgboost_tpu as xgb  # noqa: E402
+from xgboost_tpu.learner import Booster  # noqa: E402
+from xgboost_tpu.obs import comm  # noqa: E402
+from xgboost_tpu.obs.metrics import training_metrics  # noqa: E402
+from xgboost_tpu.parallel.mesh import (data_parallel_mesh,  # noqa: E402
+                                       mesh_available, set_mesh)
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.4,
+          "max_bin": 16, "hist_precision": "fixed", "dsplit": "row"}
+
+
+def make_data(n=512, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def _train_fused(n_devices, n_rounds=5, k=None, params=PARAMS):
+    """Train through update_many on an ``n_devices`` mesh (``None`` =
+    mesh-free); returns (model bytes, eval lines)."""
+    X, y = make_data()
+    Xe, ye = make_data(n=256, seed=7)
+    set_mesh(None if n_devices is None
+             else data_parallel_mesh(n_devices))
+    try:
+        d = xgb.DMatrix(X, label=y)
+        de = xgb.DMatrix(Xe, label=ye)
+        bst = Booster(params, cache=[d, de])
+        lines = []
+        bst.update_many(d, 0, n_rounds,
+                        evals=[(d, "train"), (de, "eval")],
+                        eval_callback=lambda i, m: lines.append(m),
+                        rounds_per_dispatch=k)
+        return bytes(bst.save_raw()), lines
+    finally:
+        set_mesh(None)
+
+
+@pytest.mark.skipif(not mesh_available(2), reason="needs >= 2 devices")
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_model_bytes_invariant_to_device_count(n_devices):
+    """The acceptance contract: a >= 2-device mesh-fused run produces
+    model bytes BIT-identical to the single-device fused run on the
+    same data — eval-line text included (fixed-point histograms make
+    the cross-shard reduction exactly associative)."""
+    if not mesh_available(n_devices):
+        pytest.skip(f"needs >= {n_devices} devices")
+    ref_model, ref_lines = _train_fused(1)
+    got_model, got_lines = _train_fused(n_devices)
+    assert got_lines == ref_lines
+    assert got_model == ref_model
+
+
+@pytest.mark.skipif(not mesh_available(4), reason="needs >= 4 devices")
+def test_mesh_fused_matches_mesh_free_fused():
+    """The mesh driver also bit-matches the MESH-FREE fused scan (same
+    params, no mesh installed): integer histogram sums are
+    permutation-invariant, so sharded accumulation + psum equals
+    single-array accumulation."""
+    ref_model, ref_lines = _train_fused(None)
+    got_model, got_lines = _train_fused(4)
+    assert got_lines == ref_lines
+    assert got_model == ref_model
+
+
+@pytest.mark.skipif(not mesh_available(4), reason="needs >= 4 devices")
+def test_segment_size_invariance_and_psum_accounting():
+    """Segment size must not change the model, and the mesh-fused path
+    must account its REAL collectives: max_depth psums per tree-growth
+    step with the whole-tree payload estimate, zero allreduce charges,
+    zero seconds (the psums execute inside the fused program)."""
+    n_rounds, depth, f, bins = 6, PARAMS["max_depth"], 10, PARAMS["max_bin"]
+    ref_model, ref_lines = _train_fused(4, n_rounds=n_rounds, k=None)
+    before = comm.totals()
+    got_model, got_lines = _train_fused(4, n_rounds=n_rounds, k=2)
+    after = comm.totals()
+    assert got_model == ref_model and got_lines == ref_lines
+    assert (after["psum"]["count"] - before["psum"]["count"]
+            == n_rounds * depth)
+    per_tree = ((1 << depth) - 1) * f * bins * 2 * 4
+    assert (after["psum"]["bytes"] - before["psum"]["bytes"]
+            == n_rounds * per_tree)
+    assert after["psum"]["seconds"] == before["psum"]["seconds"]
+    # satellite contract: the dispatch wall is NOT charged to allreduce
+    assert after["allreduce"]["count"] == before["allreduce"]["count"]
+    assert after["allreduce"]["seconds"] == before["allreduce"]["seconds"]
+
+
+@pytest.mark.skipif(not mesh_available(4), reason="needs >= 4 devices")
+def test_checkpoint_warm_start_bit_identity():
+    """Segment-boundary checkpoint resume through the mesh-fused
+    driver: stop after 4 of 6 rounds, reload from serialized bytes in
+    a FRESH booster, finish — bitwise equal to the uninterrupted run
+    (per-iteration fold_in seeding + associative histograms)."""
+    X, y = make_data()
+    set_mesh(data_parallel_mesh(4))
+    try:
+        d = xgb.DMatrix(X, label=y)
+        full = Booster(PARAMS, cache=[d])
+        full.update_many(d, 0, 6, rounds_per_dispatch=2)
+        want = bytes(full.save_raw())
+
+        head = Booster(PARAMS, cache=[d])
+        head.update_many(d, 0, 4, rounds_per_dispatch=2)
+        blob = head.save_raw()
+
+        d2 = xgb.DMatrix(X, label=y)
+        tail = Booster(PARAMS, cache=[d2])
+        tail.load_raw(blob)
+        tail.set_param(PARAMS)
+        assert tail.gbtree.num_boosted_rounds == 4
+        tail.update_many(d2, 4, 2, rounds_per_dispatch=2)
+        assert bytes(tail.save_raw()) == want
+    finally:
+        set_mesh(None)
+
+
+def test_fused_fallback_is_loud(monkeypatch):
+    """A multi-round run that cannot fuse must say so: the
+    xgbtpu_train_fused_fallback_total counter gains the first blocking
+    reason (here the XGBTPU_SEQ_BOOST escape hatch)."""
+    monkeypatch.setenv("XGBTPU_SEQ_BOOST", "1")
+    X, y = make_data(n=128)
+    d = xgb.DMatrix(X, label=y)
+    bst = Booster({"objective": "binary:logistic", "max_depth": 3,
+                   "eta": 0.4, "max_bin": 16}, cache=[d])
+    fb = training_metrics().fused_fallback
+    base = fb.value("seq_boost_env")
+    bst.update_many(d, 0, 3)
+    assert fb.value("seq_boost_env") == base + 1
+
+
+def test_fused_path_does_not_fall_back(monkeypatch):
+    """The inverse guard: a plain eligible run increments NOTHING —
+    chaos/bench runs rely on this counter staying flat to certify they
+    measured the fused path."""
+    monkeypatch.delenv("XGBTPU_SEQ_BOOST", raising=False)
+    X, y = make_data(n=128)
+    d = xgb.DMatrix(X, label=y)
+    bst = Booster({"objective": "binary:logistic", "max_depth": 3,
+                   "eta": 0.4, "max_bin": 16}, cache=[d])
+    fb = training_metrics().fused_fallback
+    base = sum(fb.values().values())
+    bst.update_many(d, 0, 3)
+    assert sum(fb.values().values()) == base
+
+
+# --------------------------------------------------------------- launcher
+def test_standalone_launcher_honors_local_devices(tmp_path):
+    """init_worker must apply XGBTPU_LOCAL_DEVICES even WITHOUT a
+    coordinator (the standalone gang path): the worker gets an
+    in-process multi-device view — the live mesh target on hosts whose
+    backend cannot execute multi-process programs."""
+    from xgboost_tpu.parallel.launch import launch_local
+    code = ("import os\n"
+            "assert 'XGBTPU_COORD' not in os.environ\n"
+            "from xgboost_tpu.parallel.launch import init_worker\n"
+            "assert init_worker() is False\n"
+            "import jax\n"
+            "assert jax.device_count() == 3, jax.devices()\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    rc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=dict(env, XGBTPU_LOCAL_DEVICES="3", JAX_PLATFORMS="cpu"),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=120).returncode
+    assert rc == 0
+    # and the launcher exports it from --local-devices in standalone
+    # mode (mesh-free gang-watchdog smoke: worker runs, gang exits 0)
+    rc = launch_local(
+        1, [sys.executable, "-c", code], standalone=True, local_devices=3)
+    assert rc == 0
+
+
+def test_standalone_watchdog_kills_silent_gang():
+    """Gang-watchdog smoke over the standalone plumbing: a worker that
+    never heartbeats is killed at the stall window and, with no
+    keepalive, launch_local returns STALL_RC."""
+    from xgboost_tpu.parallel.launch import STALL_RC, launch_local
+    t0 = time.monotonic()
+    rc = launch_local(
+        1, [sys.executable, "-c", "import time; time.sleep(60)"],
+        standalone=True, keepalive=False, watchdog_stall_sec=1.5)
+    assert rc == STALL_RC
+    assert time.monotonic() - t0 < 30

@@ -426,7 +426,11 @@ def test_kill_plus_torn_checkpoint_recovers_bit_identical(tmp_path, capfd):
     _write_libsvm(str(data))
     common = [f"data={data}", "task=train", "num_round=5", "silent=2",
               "objective=binary:logistic", "max_depth=3", "eta=0.5",
-              "max_bin=16"]
+              "max_bin=16",
+              # per-round segments: mock replay no longer blocks fusion,
+              # and this test's torn-member/fallback choreography needs
+              # the ring written at every round boundary
+              "rounds_per_dispatch=1"]
     m_ref = tmp_path / "ref.model"
     assert main(common + [f"model_out={m_ref}",
                           f"checkpoint_dir={tmp_path / 'ck_ref'}"]) == 0

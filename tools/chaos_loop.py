@@ -49,7 +49,12 @@ wedged worker stops touching its per-rank heartbeat file, the watchdog
 kills and restarts the gang, the restarted trial sails past the
 coordinate (ntrial semantics) and resumes from the checkpoint ring; the
 assertion is the same bit-identical-final-model contract as the death
-suite.  Emits ``TRAIN_CHAOS.json``.
+suite.  Two cells run per invocation: ``baseline`` (single-device
+segmented fused dispatch) and ``fused_mesh`` (``dsplit=row`` +
+``hist_precision=fixed`` over ``--local-devices`` in-process devices —
+the mesh-fused scan), both verified fallback-free via the obs event
+log (``train.fused_fallback`` must never appear).  Emits
+``TRAIN_CHAOS.json``.
 
 ``--fleet --slow`` arms ``slow_replica`` (a wedged-but-alive replica:
 every predict sleeps, lease and /healthz stay green) instead of kills:
@@ -97,11 +102,38 @@ def _states_equal(a, b) -> bool:
     return all(np.array_equal(a[k], b[k]) for k in a)
 
 
+def _scan_obs_events(prefix: str, name: str) -> int:
+    """Count ``name`` events across the obs JSONL file(s) a run wrote
+    (``prefix`` plus per-rank suffixes).  Append-only across gang
+    restarts, so a fallback from ANY trial stays visible."""
+    import glob
+    hits = 0
+    for path in glob.glob(prefix + "*"):
+        try:
+            with open(path) as f:
+                for line in f:
+                    if f'"name": "{name}"' in line or \
+                            f'"name":"{name}"' in line:
+                        hits += 1
+        except OSError:
+            pass
+    return hits
+
+
 def train_stall_mode(args) -> int:
     """Stall-failure training chaos: wedge the worker at a random
     collective coordinate, let the watchdog kill+restart the gang, and
     assert bit-identical resume — composed with a death on the restart
-    trial half the time (see module docstring)."""
+    trial half the time (see module docstring).
+
+    Runs TWO cells per seed: ``baseline`` (single-device, segmented
+    fused dispatch) and ``fused_mesh`` (``dsplit=row`` over
+    ``--local-devices`` in-process devices with
+    ``hist_precision=fixed``, the mesh-fused scan).  Both ride the
+    fused driver — coordinates replay at segment boundaries — and both
+    assert ZERO silent per-round fallbacks by scanning the run's obs
+    event log for ``train.fused_fallback`` (counter-backed: the same
+    events increment ``xgbtpu_train_fused_fallback_total``)."""
     import subprocess
 
     from xgboost_tpu.cli import main as cli_main
@@ -110,77 +142,112 @@ def train_stall_mode(args) -> int:
     os.makedirs(work, exist_ok=True)
     data = os.path.join(work, "train.libsvm")
     _write_libsvm(data, seed=args.seed)
+    # rounds_per_dispatch=2: several segments per run, so the stall /
+    # death coordinates land BETWEEN ring checkpoints and the restart
+    # genuinely resumes mid-training (auto-K would fuse this tiny
+    # workload into one segment and every restart would retrain from 0)
     common = [f"data={data}", "task=train", f"num_round={args.rounds}",
               "silent=2", "objective=binary:logistic", "max_depth=3",
-              "eta=0.5", "max_bin=16"]
-
-    # uninterrupted reference (checkpointing ON: identical code path)
-    ref_model = os.path.join(work, "ref.model")
-    rc = cli_main(common + [f"model_out={ref_model}",
-                            f"checkpoint_dir={os.path.join(work, 'ck_ref')}"])
-    if rc != 0:
-        print(f"reference run failed (rc={rc})", file=sys.stderr)
-        return 1
-    ref = _state(ref_model)
+              "eta=0.5", "max_bin=16", "rounds_per_dispatch=2"]
+    cells = [
+        ("baseline", [], []),
+        ("fused_mesh", ["dsplit=row", "hist_precision=fixed"],
+         ["--local-devices", str(args.local_devices)]),
+    ]
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    rng = np.random.RandomState(args.seed)
-    report = {"mode": "train_stall", "runs": args.runs,
+    report = {"mode": "train_stall", "runs_per_cell": args.runs,
+              "local_devices": args.local_devices,
               "stalls_armed": 0, "deaths_armed": 0,
               "watchdog_kills": 0, "restarts": 0,
-              "bit_identical": 0, "mismatches": 0, "run_log": []}
-    for run in range(args.runs):
-        out = os.path.join(work, f"m_{run:03d}.model")
-        vs = int(rng.randint(1, args.rounds))  # stall round (trial 0)
-        mock = f"stall:{vs},0,0"
-        report["stalls_armed"] += 1
-        entry = {"run": run, "mock": mock}
-        if rng.rand() < 0.5:
-            # compose stall with DEATH: the restarted trial (1) dies at
-            # a later coordinate, exercising watchdog-kill followed by
-            # plain keepalive restart in one recovery chain
-            vd = int(rng.randint(1, args.rounds))
-            mock += f";die:{vd},0,1"
-            entry["mock"] = mock
-            report["deaths_armed"] += 1
-        cmd = [sys.executable, "-m", "xgboost_tpu.launch", "-n", "1",
-               "--standalone", "--keepalive",
-               "--watchdog-stall-sec", str(args.stall_window),
-               "--restart-backoff-sec", "0.2", "--",
-               sys.executable, "-m", "xgboost_tpu", *common,
-               f"model_out={out}",
-               f"checkpoint_dir={os.path.join(work, f'ck_{run:03d}')}",
-               f"mock={mock}"]
-        r = subprocess.run(cmd, cwd=repo, capture_output=True,
-                           text=True, timeout=600,
-                           env=dict(os.environ, JAX_PLATFORMS="cpu"))
-        entry["rc"] = r.returncode
-        entry["watchdog_kills"] = r.stderr.count("[launch] STALL")
-        entry["restarts"] = r.stderr.count("[launch] restarting")
-        report["watchdog_kills"] += entry["watchdog_kills"]
-        report["restarts"] += entry["restarts"]
-        if r.returncode == 0 and _states_equal(ref, _state(out)):
-            report["bit_identical"] += 1
-            entry["result"] = "bit_identical"
-        else:
-            report["mismatches"] += 1
-            entry["result"] = (f"rc={r.returncode}" if r.returncode
-                               else "MISMATCH")
-            entry["stderr_tail"] = r.stderr[-1500:]
-        report["run_log"].append(entry)
-        print(f"[chaos-train] run {run}: mock={mock} -> "
-              f"{entry['result']} ({entry['watchdog_kills']} watchdog "
-              f"kill(s), {entry['restarts']} restart(s))",
-              file=sys.stderr)
+              "bit_identical": 0, "mismatches": 0,
+              "fused_fallbacks": 0, "run_log": []}
+    for cell, extra, launch_extra in cells:
+        # uninterrupted reference per cell (checkpointing ON: identical
+        # code path; the mesh cell's params change the model)
+        ref_model = os.path.join(work, f"ref_{cell}.model")
+        rc = cli_main(common + extra + [
+            f"model_out={ref_model}",
+            f"checkpoint_dir={os.path.join(work, f'ck_ref_{cell}')}"])
+        if rc != 0:
+            print(f"[chaos-train] {cell} reference run failed (rc={rc})",
+                  file=sys.stderr)
+            return 1
+        ref = _state(ref_model)
+
+        rng = np.random.RandomState(args.seed)
+        for run in range(args.runs):
+            out = os.path.join(work, f"m_{cell}_{run:03d}.model")
+            obs_log = os.path.join(work, f"obs_{cell}_{run:03d}.jsonl")
+            vs = int(rng.randint(1, args.rounds))  # stall round (trial 0)
+            mock = f"stall:{vs},0,0"
+            report["stalls_armed"] += 1
+            entry = {"cell": cell, "run": run, "mock": mock}
+            if run % 2 == 1 or rng.rand() < 0.5:
+                # compose stall with DEATH on (at least) every odd run:
+                # the restarted trial (1) dies at a later coordinate,
+                # exercising watchdog-kill followed by plain keepalive
+                # restart in one recovery chain
+                vd = int(rng.randint(1, args.rounds))
+                mock += f";die:{vd},0,1"
+                entry["mock"] = mock
+                report["deaths_armed"] += 1
+            cmd = [sys.executable, "-m", "xgboost_tpu.launch", "-n", "1",
+                   "--standalone", "--keepalive", *launch_extra,
+                   "--watchdog-stall-sec", str(args.stall_window),
+                   "--restart-backoff-sec", "0.2", "--",
+                   sys.executable, "-m", "xgboost_tpu", *common, *extra,
+                   f"model_out={out}",
+                   f"checkpoint_dir={os.path.join(work, f'ck_{cell}_{run:03d}')}",
+                   f"mock={mock}"]
+            # XGBTPU_OBS_PHASES=0: the event log must witness the run
+            # WITHOUT forcing per-round phases (which would itself
+            # block fusion — the fallback we are asserting against)
+            r = subprocess.run(cmd, cwd=repo, capture_output=True,
+                               text=True, timeout=600,
+                               env=dict(os.environ, JAX_PLATFORMS="cpu",
+                                        XGBTPU_OBS_LOG=obs_log,
+                                        XGBTPU_OBS_PHASES="0"))
+            entry["rc"] = r.returncode
+            entry["watchdog_kills"] = r.stderr.count("[launch] STALL")
+            entry["restarts"] = r.stderr.count("[launch] restarting")
+            # the LOUD-fallback contract: every trial of every run must
+            # have taken the fused driver (per-round fallback emits a
+            # train.fused_fallback event + counter)
+            entry["fused_fallbacks"] = _scan_obs_events(
+                obs_log, "train.fused_fallback")
+            report["watchdog_kills"] += entry["watchdog_kills"]
+            report["restarts"] += entry["restarts"]
+            report["fused_fallbacks"] += entry["fused_fallbacks"]
+            if (r.returncode == 0 and _states_equal(ref, _state(out))
+                    and entry["fused_fallbacks"] == 0):
+                report["bit_identical"] += 1
+                entry["result"] = "bit_identical"
+            else:
+                report["mismatches"] += 1
+                entry["result"] = (
+                    f"rc={r.returncode}" if r.returncode
+                    else "FUSED_FALLBACK" if entry["fused_fallbacks"]
+                    else "MISMATCH")
+                entry["stderr_tail"] = r.stderr[-1500:]
+            report["run_log"].append(entry)
+            print(f"[chaos-train] {cell} run {run}: mock={mock} -> "
+                  f"{entry['result']} ({entry['watchdog_kills']} "
+                  f"watchdog kill(s), {entry['restarts']} restart(s), "
+                  f"{entry['fused_fallbacks']} fused fallback(s))",
+                  file=sys.stderr)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
-    print(f"[chaos-train] {report['bit_identical']}/{args.runs} "
+    total = args.runs * len(cells)
+    print(f"[chaos-train] {report['bit_identical']}/{total} "
           f"bit-identical across {report['watchdog_kills']} watchdog "
-          f"kills / {report['restarts']} restarts -> {args.out}",
+          f"kills / {report['restarts']} restarts "
+          f"({report['fused_fallbacks']} fused fallbacks) -> {args.out}",
           file=sys.stderr)
     ok = (report["mismatches"] == 0 and report["watchdog_kills"] >= 1
-          and report["restarts"] >= report["watchdog_kills"])
+          and report["restarts"] >= report["watchdog_kills"]
+          and report["fused_fallbacks"] == 0)
     return 0 if ok else 1
 
 
@@ -581,7 +648,13 @@ def main(argv=None) -> int:
                          "restarts, bit-identical resume "
                          "(TRAIN_CHAOS.json; see module docstring)")
     ap.add_argument("--stall-window", type=float, default=4.0,
-                    help="--train: launcher --watchdog-stall-sec")
+                    help="--train: launcher --watchdog-stall-sec; must "
+                         "cover startup + one fused segment dispatch "
+                         "(compile included on the first trial)")
+    ap.add_argument("--local-devices", type=int, default=2,
+                    help="--train: in-process device count for the "
+                         "fused_mesh cell (dsplit=row over an "
+                         "N-virtual-CPU-device mesh)")
     ap.add_argument("--pipeline", action="store_true",
                     help="continuous-training mode: SIGKILL/corrupt "
                          "the train→gate→publish→reload boundary under "

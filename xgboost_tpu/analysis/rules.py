@@ -543,8 +543,11 @@ class CollectiveUnderRankBranch(Rule):
     code = "XGT007"
     name = "collective-under-rank-branch"
 
+    # learner.py joined the scope with the mesh-fused scan driver: its
+    # update_many/_eval_parts_sharded paths issue allsum/allgatherv
+    # collectives that every rank must reach
     SCOPED_PATHS = ("parallel/", "cli.py", "models/gbtree.py",
-                    "obs/comm.py")
+                    "obs/comm.py", "learner.py")
 
     def applies(self, path: str) -> bool:
         return _path_has(path, self.SCOPED_PATHS)

@@ -430,9 +430,10 @@ class BoostLearnTask:
         per-round granularity/bit-identity, checkpoints write at
         segment boundaries (a mid-segment SIGKILL resumes from the last
         boundary's ring member and retrains bit-identically — per-round
-        fold_in seeding).  Ineligible configs (mock faults, pruning,
-        external memory, profiler/obs phases, ...) and
-        rounds_per_dispatch=0 run the same hooks one round at a time."""
+        fold_in seeding).  Ineligible configs (pruning, external
+        memory, profiler/obs phases, ...) and rounds_per_dispatch=0
+        run the same hooks one round at a time; ``mock=`` faults ride
+        the fused path (coordinates replay at segment boundaries)."""
 
         def plan_cb(k: int) -> None:
             if self.silent or not k:
@@ -503,7 +504,7 @@ class BoostLearnTask:
         # lines, save_period and checkpoint_dir land at per-round /
         # segment-boundary granularity WITHOUT forcing per-round device
         # dispatches (update_many falls back per-round when fusion is
-        # ineligible — mock, pruning, external memory, profiler, ...)
+        # ineligible — pruning, external memory, profiler, ...)
         self._train_rounds(bst, data, evals, start_round, start)
         # save final round unless a periodic numbered save already covered
         # it (reference xgboost_main.cpp:219-225: no final save when

@@ -82,7 +82,10 @@ class TrainParam:
     device_sketch: int = -1
     # histogram accumulation precision (recorded in saved models):
     # "auto" = bf16 MXU kernel on TPU / exact scatter elsewhere;
-    # "fp32" forces exact-f32 histograms; "bf16" forces the MXU pass.
+    # "fp32" forces exact-f32 histograms; "bf16" forces the MXU pass;
+    # "fixed" forces int32 fixed-point scatter accumulation (exactly
+    # associative -> model bytes bitwise invariant to the data-mesh
+    # device count; ops/histogram.FIXED_SCALE documents resolution).
     # XGBTPU_HIST remains an env override (test seam).
     hist_precision: str = "auto"
     # histogram subtraction + row compaction (build only the smaller

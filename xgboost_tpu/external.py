@@ -511,7 +511,7 @@ def _paged_level_hist(tree: TreeArrays, binned: jax.Array, gh: jax.Array,
     n_node = 1 << depth
     pos = jnp.where(alive, node - (n_node - 1), -1)
     hist = build_level_histogram(binned, gh, pos, n_node, n_bin, precision)
-    return hist, node_stats(gh, pos, n_node)
+    return hist, node_stats(gh, pos, n_node, precision)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
@@ -539,9 +539,10 @@ def _paged_level_hist_dp(mesh, tree: TreeArrays, binned: jax.Array,
                                                   depth, n_bin, precision)
         return (jax.lax.psum(hist, "data"), jax.lax.psum(nst, "data"))
 
-    fn = jax.shard_map(shard_fn, mesh=mesh,
-                       in_specs=(P(), P("data"), P("data")),
-                       out_specs=(P(), P()), check_vma=False)
+    from xgboost_tpu.parallel.mesh import shard_map
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(), P("data"), P("data")),
+                   out_specs=(P(), P()), check_vma=False)
     return fn(tree, binned, gh)
 
 
@@ -598,6 +599,11 @@ def grow_tree_paged(key, dmat: ExtMemDMatrix, gh: np.ndarray,
                                          cfg.n_bin, cfg.hist_precision)
             hist = h if hist is None else hist + h
             nst = s if nst is None else nst + s
+        # "fixed" mode batches accumulate exact int32; decode once per
+        # level after the cross-batch/cross-shard sums
+        from xgboost_tpu.ops.histogram import dequantize_hist
+        hist = dequantize_hist(hist)
+        nst = dequantize_hist(nst)
         if depth == cfg.max_depth:
             make_leaf = jnp.ones(n_node, jnp.bool_)
             best = None

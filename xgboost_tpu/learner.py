@@ -912,10 +912,16 @@ class Booster:
 
         Falls back to per-round :meth:`update` (same callbacks, one
         boundary per round) when fusion is ineligible — custom/host
-        objective, pruning, refresh, fault injection, column split,
-        profiler/obs phases, external-memory or sharded matrices — or
-        when the resolved segment size is 0 (the per-round A/B
-        baseline).
+        objective, pruning, refresh, column split, profiler/obs
+        phases, external-memory matrices — or when the resolved
+        segment size is 0 (the per-round A/B baseline).  Every
+        fallback is LOUD: ``xgbtpu_train_fused_fallback_total`` and a
+        ``train.fused_fallback`` event record the first blocking
+        reason, so chaos/bench runs meant to measure the fused path
+        can assert it never silently degraded.  Fault injection
+        (``mock=``) no longer forces the fallback: the fused driver
+        replays the injector's (version, seqno) coordinates at
+        segment boundaries.
 
         Driver hooks (all optional; the CLI and ContinuousTrainer ride
         these instead of owning round loops):
@@ -936,7 +942,6 @@ class Booster:
           materialized exactly there).
         """
         from xgboost_tpu.models.updaters import parse_updaters
-        from xgboost_tpu.parallel import mock
 
         self._lazy_init(dtrain)
         entry = self._entry(dtrain)
@@ -949,33 +954,52 @@ class Booster:
                 return self.obj.fused_grad(entry.info,
                                            pad_prep=entry.rank_pad_prep)
             return self.obj.fused_grad(entry.info)
-        # device-resident eval needs every watchlist margin to live in
-        # the scan carry: sharded sets reduce metric partials across
-        # processes and external sets page batches — both per-round
-        fused_ok = (
-            fobj is None
-            and n_rounds > 1
-            and self.param.booster == "gbtree"
-            and not entry.external
-            and self._col_mesh is None
-            and not mock.active()
+        # Eligibility as (reason, blocked) pairs so a fallback is LOUD:
+        # chaos/bench runs that mean to measure the fused path verify
+        # the fused_fallback counter stayed 0.  Fault injection (mock)
+        # no longer blocks fusion — do_boost_fused replays the
+        # injector's round/seqno coordinates before each dispatch.
+        # Sharded watchlist sets ride the scan carry like any mesh
+        # entry; their eval lines reduce metric partials via
+        # ShardedDMatrix.allsum (_eval_parts_sharded) — only a custom
+        # feval (needs the full vector on one host) excludes them.
+        # External-memory sets still page batches per round.
+        checks = (
+            ("custom_objective", fobj is not None),
+            ("single_round", n_rounds <= 1),
+            ("booster", self.param.booster != "gbtree"),
+            ("external_train", bool(entry.external)),
+            ("col_split", self._col_mesh is not None),
             # escape hatch: sequential per-round launches (the fused
             # scan always grows the round's ensemble vmapped)
-            and not os.environ.get("XGBTPU_SEQ_BOOST")
-            and self.profiler is None
-            and not (self.param.gamma > 0.0 and "prune" in ups)
-            and max(1, self.param.num_roots) == 1
-            and not getattr(self.gbtree, "exact_raw", False)
-            and "refresh" not in ups
-            and any(u.startswith("grow") for u in ups)
-            and fgrad() is not None
-            and all(not getattr(d, "is_sharded", False)
-                    and not self._entry(d).external for d, _ in evals))
+            ("seq_boost_env", bool(os.environ.get("XGBTPU_SEQ_BOOST"))),
+            ("profiler", self.profiler is not None),
+            ("prune", self.param.gamma > 0.0 and "prune" in ups),
+            ("multi_root", max(1, self.param.num_roots) != 1),
+            ("exact", bool(getattr(self.gbtree, "exact_raw", False))),
+            ("refresh", "refresh" in ups),
+            ("no_grow_updater",
+             not any(u.startswith("grow") for u in ups)),
+            ("no_fused_grad", fgrad() is None),
+            ("external_eval",
+             any(self._entry(d).external for d, _ in evals)),
+            ("sharded_eval_feval", feval is not None and any(
+                getattr(d, "is_sharded", False) for d, _ in evals)),
+        )
+        blockers = [name for name, blocked in checks if blocked]
+        fused_ok = not blockers
         k = (self._resolve_rounds_per_dispatch(
             dtrain.num_row, rounds_per_dispatch) if fused_ok else 0)
         if plan_callback is not None:
             plan_callback(k)
         if not fused_ok or k <= 0:
+            if n_rounds > 1 and self.param.booster == "gbtree":
+                why = blockers or ["rounds_per_dispatch_0"]
+                from xgboost_tpu.obs import trace, training_metrics
+                training_metrics().fused_fallback.inc(why[0])
+                trace.event("train.fused_fallback", reasons=why,
+                            first_iteration=first_iteration,
+                            n_rounds=n_rounds)
             from contextlib import nullcontext
             for i in range(first_iteration, first_iteration + n_rounds):
                 if round_callback is not None:
@@ -1021,7 +1045,8 @@ class Booster:
                 eval_margins=tuple(e.margin for _, _, e, t in espec
                                    if not t),
                 eval_is_train=tuple(t for _, _, _, t in espec),
-                etransform=etransform)
+                etransform=etransform,
+                rowwise_grad=entry.rank_pad_prep is None)
             entry.margin = margin_f
             entry.applied = self.gbtree.num_trees
             ei = 0
@@ -1038,6 +1063,16 @@ class Booster:
                 for r in range(seg):
                     parts = [f"[{first + r}]"]
                     for si, (dmat, name, e, _) in enumerate(espec):
+                        if getattr(dmat, "is_sharded", False):
+                            # split-loaded set: metric partials on the
+                            # LOCAL shard of the round's transformed
+                            # outputs, reduced via allsum — no process
+                            # ever holds the full prediction vector
+                            local = dmat.local_block_of(eouts[si][r])
+                            self._eval_parts_sharded(
+                                dmat, name,
+                                local[:dmat.local_num_row], parts)
+                            continue
                         tr = e.user_rows(np.asarray(self._replicated(
                             eouts[si][r])))
                         self._eval_parts(dmat, name, tr, parts, feval)
@@ -1550,7 +1585,16 @@ class Booster:
                 "custom feval needs the full prediction vector on one "
                 "host; load the eval set replicated (DMatrix) instead")
         local = dmat.local_block_of(self.obj.eval_transform(entry.margin))
-        preds = local[:dmat.local_num_row]
+        self._eval_parts_sharded(dmat, name, local[:dmat.local_num_row],
+                                 parts)
+
+    def _eval_parts_sharded(self, dmat, name: str, preds,
+                            parts: List[str]) -> None:
+        """The partial-sum metric core shared by the per-round sharded
+        eval path (:meth:`_eval_sharded`) and the mesh-fused driver
+        (:meth:`update_many`, which hands in the LOCAL user rows of one
+        round's transformed scan outputs).  ``preds`` is this process's
+        (local_num_row, K) transformed prediction block."""
         labels = np.asarray(dmat.info.label)
         weights = np.asarray(dmat.info.get_weight(dmat.local_num_row))
         for m in self._metrics():

@@ -86,7 +86,8 @@ def _scan_rounds_impl(binned, margin, label, weight, base_key,
                       binned_t, eval_binned, eval_margins, *,
                       n_rounds: int, K: int,
                       npar: int, cfg: GrowConfig, split_finder, grad_fn,
-                      mesh, eval_is_train, etransform, pred_chunk: int):
+                      mesh, eval_is_train, etransform, pred_chunk: int,
+                      hist_reduce=None):
     """``lax.scan`` over whole boosting rounds (one device launch for
     n_rounds x K x npar trees).  Module-level so the jit cache is shared
     across Booster instances: all static arguments (cfg, grad_fn,
@@ -124,6 +125,7 @@ def _scan_rounds_impl(binned, margin, label, weight, base_key,
         else:
             tree, row_leaf, d = grow_tree(
                 tkey, binned, gh2, cut_values, n_cuts, cfg, row_valid,
+                hist_reduce=hist_reduce,
                 split_finder=split_finder, binned_t=binned_t)
         if row_valid is not None:
             d = d * row_valid.astype(d.dtype)
@@ -173,20 +175,89 @@ def _scan_rounds_impl(binned, margin, label, weight, base_key,
     return margin, eval_margins, stacks, eouts
 
 
-# Two jit wrappings of ONE round-scan implementation: the donating
-# variant hands the margin (arg 1) and eval-margin (arg 11) carries'
+def _scan_rounds_mesh_impl(binned, margin, label, weight, base_key,
+                           first_iteration, cut_values, n_cuts, row_valid,
+                           binned_t, eval_binned, eval_margins, *,
+                           n_rounds: int, K: int,
+                           npar: int, cfg: GrowConfig, split_finder,
+                           grad_fn, mesh, eval_is_train, etransform,
+                           pred_chunk: int):
+    """The K-round scan under ONE ``shard_map`` over the 'data' axis.
+
+    Where :func:`_scan_rounds_impl` with ``mesh`` nests a per-tree
+    ``grow_tree_dp`` shard_map INSIDE the scan (a shard_map entry/exit
+    per tree-growth step, and GSPMD left to infer the sharding of the
+    margin/eval carries between them), this wraps the WHOLE scan body
+    in a single shard_map: rows stay shard-resident for the entire
+    segment, the per-level histogram/node-stat psums
+    (``dp._psum_data`` via grow_tree's ``hist_reduce`` seam) are the
+    ONLY collectives in the program, watchlist eval margins accumulate
+    per shard, and the host is contacted exactly once per segment.
+    Tree stacks replicate for free — after each level's psum every
+    shard computes the identical argmax split (the reference's
+    TreeSyncher no-op, updater_sync-inl.hpp:34-49).
+
+    Gradients must be rowwise (reg/softmax ``fused_grad``): the
+    LambdaRank pad path needs global group structure, so its mesh runs
+    keep the nested-``grow_tree_dp`` scan (update_many routes by
+    ``entry.rank_pad_prep``).  Same per-round fold_in keys as every
+    other boost path — with an exactly-associative histogram mode
+    (``hist_precision=fixed``) the model bytes are invariant to the
+    mesh device count (tests/test_mesh_fused.py).
+    """
+    from jax.sharding import PartitionSpec as P
+    from xgboost_tpu.parallel.dp import _psum_data
+    from xgboost_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    D = P(DATA_AXIS)
+    R = P()
+
+    def body(binned, margin, label, weight, base_key, first_iteration,
+             cut_values, n_cuts, row_valid, eval_binned, eval_margins):
+        return _scan_rounds_impl(
+            binned, margin, label, weight, base_key, first_iteration,
+            cut_values, n_cuts, row_valid, None, eval_binned,
+            eval_margins, n_rounds=n_rounds, K=K, npar=npar, cfg=cfg,
+            split_finder=split_finder, grad_fn=grad_fn, mesh=None,
+            eval_is_train=eval_is_train, etransform=etransform,
+            pred_chunk=pred_chunk, hist_reduce=_psum_data)
+
+    # check_vma=False + out_specs P() for the tree stacks: replicated
+    # by the psum'd split argmax (the grow_tree_dp convention).  The
+    # per-round transformed eval outputs stack rounds on axis 0 with
+    # rows still sharded on axis 1.
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(D, D, D, D, R, R, R, R, D, D, D),
+        out_specs=(D, D, R, P(None, DATA_AXIS)),
+        check_vma=False)
+    return fn(binned, margin, label, weight, base_key, first_iteration,
+              cut_values, n_cuts, row_valid, eval_binned, eval_margins)
+
+
+# Jit wrappings of the round-scan implementations: the donating
+# variants hand the margin (arg 1) and eval-margin (arg 11) carries'
 # buffers to XLA so segment k+1 updates segment k's output in place —
 # no per-segment device copy of the O(N*K) state.  CPU ignores donation
 # (with a UserWarning per call), so callers pick the wrapper by backend
-# (do_boost_fused; XGBTPU_FUSED_DONATE overrides for A/Bs).
+# (do_boost_fused; XGBTPU_FUSED_DONATE overrides for A/Bs).  The
+# ``_mesh`` pair compiles the whole-scan shard_map (mesh-fused
+# training); ``_scan_rounds`` keeps ``mesh`` for the legacy
+# nested-grow_tree_dp scan (rank objectives).
 _SCAN_STATIC = ("n_rounds", "K", "npar", "cfg", "split_finder",
                 "grad_fn", "mesh", "eval_is_train", "etransform",
                 "pred_chunk")
 _scan_rounds = functools.partial(
-    jax.jit, static_argnames=_SCAN_STATIC)(_scan_rounds_impl)
+    jax.jit,
+    static_argnames=_SCAN_STATIC + ("hist_reduce",))(_scan_rounds_impl)
 _scan_rounds_donated = functools.partial(
-    jax.jit, static_argnames=_SCAN_STATIC,
+    jax.jit, static_argnames=_SCAN_STATIC + ("hist_reduce",),
     donate_argnums=(1, 11))(_scan_rounds_impl)
+_scan_rounds_mesh = functools.partial(
+    jax.jit, static_argnames=_SCAN_STATIC)(_scan_rounds_mesh_impl)
+_scan_rounds_mesh_donated = functools.partial(
+    jax.jit, static_argnames=_SCAN_STATIC,
+    donate_argnums=(1, 11))(_scan_rounds_mesh_impl)
 
 
 class GBTree:
@@ -558,7 +629,8 @@ class GBTree:
                        first_iteration: int, n_rounds: int,
                        row_valid=None, mesh=None, binned_t=None,
                        eval_binned=(), eval_margins=(),
-                       eval_is_train=(), etransform=None, donate=None):
+                       eval_is_train=(), etransform=None, donate=None,
+                       rowwise_grad: bool = True):
         """Scan ``n_rounds`` whole boosting rounds in ONE device launch.
 
         Per-round host dispatch (gradient launch + growth launch + margin
@@ -575,8 +647,13 @@ class GBTree:
 
         Restrictions (callers fall back to per-round ``do_boost``):
         no pruning (``gamma > 0`` pruning is a host-side pass), no
-        refresh, no column split, no fault injection, and a jittable
-        gradient function (standard reg/softmax objectives).
+        refresh, no column split, and a jittable gradient function
+        (standard reg/softmax objectives).  Fault injection IS
+        compatible: the per-round injector coordinates replay host-side
+        BEFORE the segment dispatches (same round/seqno space as the
+        per-round path), so a simulated death or stall fires at a
+        segment boundary and resume from the checkpoint ring replays
+        the whole segment bit-identically.
 
         Args:
           margin: (N, K) current margins (device).
@@ -585,6 +662,12 @@ class GBTree:
             gradient with stable identity (Objective.fused_grad).
           row_valid: optional (N,) bool mask of real rows.
           mesh: optional data-parallel mesh (rows sharded over 'data').
+          rowwise_grad: ``grad_fn`` is a pure per-row map (standard
+            reg/softmax fused gradients) — with ``mesh`` this selects
+            the whole-scan shard_map driver
+            (:func:`_scan_rounds_mesh_impl`); group-structured
+            gradients (LambdaRank pad path) keep the legacy
+            nested-``grow_tree_dp`` scan.
           eval_binned / eval_margins / eval_is_train / etransform:
             device-resident watchlist evaluation (see
             :func:`_scan_rounds_impl`) — per-round transformed eval
@@ -607,20 +690,39 @@ class GBTree:
                 donate = env == "1"
             else:
                 donate = jax.default_backend() != "cpu"
-        # the fused scan still performs one logical histogram allreduce
-        # per tree; keep the comm/seqno count space identical to the
-        # per-round path (the injector is never armed here — fused
-        # launches are ineligible while mock.active())
-        from xgboost_tpu.obs import comm, span, training_metrics
+        mesh_scan = mesh is not None and rowwise_grad
+        # the fused scan still performs the per-round collectives; keep
+        # the comm/seqno count space identical to the per-round path by
+        # replaying one injector-seam entry per tree-growth step BEFORE
+        # the dispatch (an armed die/stall fires here, at the segment
+        # boundary — the checkpoint ring then replays the segment).
+        # The mesh-fused driver counts its REAL collectives: one
+        # histogram psum per level per tree into the xgbtpu_comm_psum_*
+        # families (max_depth per growth step; the terminal level's
+        # node stats derive from the parent's split — no reduction).
+        # Single-device/legacy launches keep the per-round path's
+        # logical "allreduce" accounting; NOTHING charges the dispatch
+        # wall time to a collective family — that wall time is device
+        # compute and belongs to xgbtpu_train_dispatch_seconds alone.
+        from xgboost_tpu.obs import span, training_metrics
         from xgboost_tpu.parallel import mock
         comm_nbytes = self._comm_bytes(binned.shape[1], mesh)
         for r in range(n_rounds):
             mock.begin_round(first_iteration + r)
             for _ in range(K * npar):
-                mock.collective(nbytes=comm_nbytes)
-        scan = _scan_rounds_donated if donate else _scan_rounds
+                if mesh_scan:
+                    mock.collective("psum", nbytes=comm_nbytes,
+                                    count=self.cfg.max_depth)
+                else:
+                    mock.collective(nbytes=comm_nbytes)
+        if mesh_scan:
+            scan = _scan_rounds_mesh_donated if donate \
+                else _scan_rounds_mesh
+        else:
+            scan = _scan_rounds_donated if donate else _scan_rounds
         with span("train.dispatch", first_round=first_iteration,
-                  n_rounds=n_rounds, donated=bool(donate)):
+                  n_rounds=n_rounds, donated=bool(donate),
+                  mesh_fused=bool(mesh_scan)):
             _t_launch = time.perf_counter()
             margin_f, emargins_f, stacks, eouts = scan(
                 binned, margin, label, weight,
@@ -637,7 +739,6 @@ class GBTree:
             # histogram must record device wall time, not async dispatch
             jax.block_until_ready(margin_f)
             _dt = time.perf_counter() - _t_launch
-        comm.record("allreduce", count=0, seconds=_dt)
         tm = training_metrics()
         tm.dispatch_seconds.observe(_dt)
         tm.rounds_per_dispatch.set(float(n_rounds))

@@ -22,7 +22,8 @@ from typing import Callable, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from xgboost_tpu.ops.histogram import (build_level_histogram, node_stats,
+from xgboost_tpu.ops.histogram import (build_level_histogram,
+                                       dequantize_hist, node_stats,
                                        stats_from_histogram)
 from xgboost_tpu.ops.split import SplitConfig, calc_weight, find_best_splits
 
@@ -51,7 +52,9 @@ class GrowConfig(NamedTuple):
     subsample: float = 1.0
     colsample_bytree: float = 1.0
     colsample_bylevel: float = 1.0
-    hist_precision: str = "auto"  # auto | fp32 | bf16 (named TrainParam)
+    hist_precision: str = "auto"  # auto | fp32 | bf16 | int8 | fixed
+    # (named TrainParam; "fixed" = int32 fixed-point scatter — bitwise
+    # deterministic across any data-mesh size, ops/histogram.FIXED_SCALE)
     # histogram subtraction: per parent, build only the SMALLER child's
     # histogram over row-compacted buffers and derive the sibling as
     # parent - small (the reference builds every node's histogram,
@@ -190,7 +193,7 @@ def _subtracted_level_hist(binned, gh_used, pos, n_node: int, cfg,
     overflow the buffer flips ALL shards to the plain full build
     (lax.cond on a psum'd flag — collective-safe).
     """
-    from xgboost_tpu.ops.histogram import node_stats
+    from xgboost_tpu.ops.histogram import dequantize_hist, node_stats
 
     N, F = binned.shape
     B = cfg.n_bin
@@ -215,8 +218,8 @@ def _subtracted_level_hist(binned, gh_used, pos, n_node: int, cfg,
         pos_small = jnp.full(cap, -1, jnp.int32).at[dest].set(
             pos, mode="drop")
         from xgboost_tpu.ops.histogram import build_level_histogram
-        hist_small = red(build_level_histogram(
-            b_small, gh_small, pos_small, n_node, B, cfg.hist_precision))
+        hist_small = dequantize_hist(red(build_level_histogram(
+            b_small, gh_small, pos_small, n_node, B, cfg.hist_precision)))
         # the small child's histogram per parent is the pair-sum (the
         # non-built sibling's slots are zero)
         small_of_parent = hist_small.reshape(
@@ -232,8 +235,8 @@ def _subtracted_level_hist(binned, gh_used, pos, n_node: int, cfg,
 
     def full_build():
         from xgboost_tpu.ops.histogram import build_level_histogram
-        return red(build_level_histogram(binned, gh_used, pos, n_node, B,
-                                         cfg.hist_precision))
+        return dequantize_hist(red(build_level_histogram(
+            binned, gh_used, pos, n_node, B, cfg.hist_precision)))
 
     # the N/2 bound holds for GLOBAL counts; a skewed shard can still
     # overflow its local buffer, so reduce the local overflow flag and
@@ -371,7 +374,9 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
                     [jnp.stack([gl, gr], 1).reshape(-1),
                      jnp.stack([hl, hr], 1).reshape(-1)], axis=1)
             else:
-                nst = red(node_stats(gh_used, pos, n_node))  # (n_node, 2)
+                nst = dequantize_hist(red(node_stats(
+                    gh_used, pos, n_node,
+                    cfg.hist_precision)))  # (n_node, 2)
             make_leaf = jnp.ones(n_node, jnp.bool_)
             best = None
         else:
@@ -381,11 +386,12 @@ def grow_tree(key: jax.Array, binned: jax.Array, gh: jax.Array,
                                               n_node, cfg, red, hist_prev,
                                               prev[2])
             else:
-                hist = red(build_level_histogram(binned, gh_used, pos,
-                                                 n_node, cfg.n_bin,
-                                                 cfg.hist_precision,
-                                                 prep=hist_prep,
-                                                 native=native))
+                hist = dequantize_hist(
+                    red(build_level_histogram(binned, gh_used, pos,
+                                              n_node, cfg.n_bin,
+                                              cfg.hist_precision,
+                                              prep=hist_prep,
+                                              native=native)))
             hist_prev = hist if cfg.hist_subtraction else None
             # node totals fall out of the histogram (bin sums of any one
             # feature) — saves a per-level pass over all rows

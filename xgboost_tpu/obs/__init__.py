@@ -51,8 +51,17 @@ def phases_enabled() -> bool:
     ``profile>=1``: the event log is configured, the metrics server is
     up, or ``XGBTPU_OBS=1``.  Phase timing forces device barriers at
     phase boundaries (and keeps the round loop on the host), so it is
-    opt-in — the same cost contract as ``profile=1`` (PROFILE.md)."""
+    opt-in — the same cost contract as ``profile=1`` (PROFILE.md).
+
+    ``XGBTPU_OBS_PHASES=0`` keeps a configured event log / metrics
+    server WITHOUT the phase barriers: discrete events and dispatch
+    spans still land in the JSONL log, but the fused multi-round
+    dispatch stays eligible.  The chaos suite's fallback-free
+    verification rides this — it needs ``train.fused_fallback`` events
+    observable without the observer forcing the fallback."""
     import os
+    if os.environ.get("XGBTPU_OBS_PHASES", "") == "0":
+        return False
     if get_log() is not None or get_metrics_server() is not None:
         return True
     return os.environ.get("XGBTPU_OBS", "") not in ("", "0")

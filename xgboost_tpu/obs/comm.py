@@ -15,10 +15,19 @@ Instrumented seams:
 - ``parallel/mock.py collective()`` — one ``allreduce`` count (+payload
   estimate) per tree-growth launch, so ``xgbtpu_comm_allreduce_total``
   matches the mock seam's seqno count by construction;
-- the growth launches themselves (``models/gbtree.py``) add wall
-  seconds via :func:`timed` with ``count=0`` — host-side launch time;
-  the device-side collective is inside XLA and visible only to
-  ``profile=2`` traces;
+- the per-round growth launches (``models/gbtree.py do_boost``) add
+  wall seconds via :func:`timed`/:func:`record` with ``count=0`` —
+  host-side launch time; the device-side collective is inside XLA and
+  visible only to ``profile=2`` traces;
+- the MESH-FUSED scan (``do_boost_fused`` under a data mesh) counts
+  its real in-scan reductions as ``psum``: ``max_depth`` histogram
+  psums per tree-growth step with the whole-tree payload estimate in
+  ``xgbtpu_comm_psum_bytes_total``.  Its ``seconds`` counter stays 0
+  by design — the psums execute inside ONE fused device program, so
+  per-collective wall time is not observable host-side (the measured
+  per-round psum cost lives in MULTICHIP_r06.json, fitted by
+  ``tools/fit_round_model.py``'s mesh cell); the dispatch wall goes to
+  ``xgbtpu_train_dispatch_seconds``, never to a collective family;
 - ``parallel/sharded.py`` eval collectives (``allsum``/``allgatherv``)
   and ``parallel/colsplit.py`` per-level split gathers record as
   ``allgather`` with real payload bytes.
@@ -35,7 +44,7 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Optional
 
-OPS = ("allreduce", "allgather")
+OPS = ("allreduce", "allgather", "psum")
 
 _lock = threading.Lock()
 _metrics = None

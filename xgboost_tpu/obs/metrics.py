@@ -465,11 +465,22 @@ class TrainingMetrics:
             "xgbtpu_train_rounds_per_dispatch",
             "rounds covered by the most recent fused training dispatch "
             "(segment size; stays 0 on the per-round path)")
+        # loud fallback accounting: a multi-round train request that
+        # took the per-round path instead of segmented fusion, by the
+        # first failing eligibility reason (update_many's gate).  A
+        # chaos or bench run that MEANT to measure the fused path
+        # asserts this stays 0 (paired with the train.fused_fallback
+        # obs event carrying the full reason list).
+        self.fused_fallback = LabeledCounter(
+            "xgbtpu_train_fused_fallback_total", "reason",
+            "multi-round training runs that fell back from segmented "
+            "round fusion to per-round dispatch, by first failing "
+            "eligibility reason")
         self._all = (self.rounds, self.round, self.round_seconds,
                      self.phase_seconds, self.eval_score,
                      self.checkpoints, self.checkpoint_seconds,
                      self.device_memory, self.dispatch_seconds,
-                     self.rounds_per_dispatch)
+                     self.rounds_per_dispatch, self.fused_fallback)
         registry().register("training", self.render)
 
     def observe_eval(self, scores: Dict[str, float]) -> None:

@@ -24,6 +24,10 @@ import jax.numpy as jnp
 
 
 def _impl(precision: str = "auto") -> str:
+    if precision == "fixed":
+        # deterministic fixed-point accumulation: always the scatter
+        # path (on every backend) with int32 cells — see FIXED_SCALE.
+        return "scatter"
     forced = os.environ.get("XGBTPU_HIST", "")
     if forced:
         if forced not in ("pallas", "pallas_bf16", "pallas_int8",
@@ -89,6 +93,26 @@ def _pallas_hist_vmappable(n_node: int, n_bin: int, precision: str,
             interpret=interpret)
         return out, True
 
+    return hist
+
+
+# hist_precision="fixed": gradients are rounded to multiples of
+# 1/FIXED_SCALE and accumulated in int32.  Integer addition is exactly
+# associative, so the per-(node, feature, bin) sums — and therefore the
+# grown trees — are bitwise identical for ANY grouping of the rows:
+# single device, or row shards combined by `lax.psum` over a data mesh
+# of any size (the mesh-fused parity contract,
+# tests/test_mesh_fused.py).  Resolution: |g| <= 2^20/FIXED_SCALE per
+# row before saturation matters; cells overflow at ~2^31/(FIXED_SCALE
+# * max|g|) rows per (node, bin) — ~1M unit-scale rows at 2^11.
+FIXED_SCALE = 2048.0
+
+
+def dequantize_hist(hist: jax.Array) -> jax.Array:
+    """Undo the "fixed" mode's int32 fixed-point encoding AFTER the
+    cross-shard reduction (identity on float histograms/node stats)."""
+    if jnp.issubdtype(hist.dtype, jnp.integer):
+        return hist.astype(jnp.float32) * jnp.float32(1.0 / FIXED_SCALE)
     return hist
 
 
@@ -214,7 +238,10 @@ def build_level_histogram(binned: jax.Array, gh: jax.Array, pos: jax.Array,
       pos:    (N,) level-local node position in [0, n_node), -1 = inactive.
       n_node: static number of nodes at this level (2**depth).
       n_bin:  static number of bins B.
-      precision: hist_precision TrainParam (auto | fp32 | bf16 | int8).
+      precision: hist_precision TrainParam (auto | fp32 | bf16 | int8 |
+              fixed).  "fixed" returns INT32 fixed-point sums (see
+              FIXED_SCALE) — callers apply :func:`dequantize_hist`
+              after their cross-shard reduction.
       prep:   optional :class:`HistPrep` from :func:`prepare_hist` —
               the level loop hoists the bins transpose and gradient
               quantization to once per tree instead of once per level.
@@ -244,14 +271,28 @@ def build_level_histogram(binned: jax.Array, gh: jax.Array, pos: jax.Array,
     flat = (pos[:, None] * F + f_ids) * n_bin + binned.astype(jnp.int32)
     # inactive rows (pos < 0) -> out-of-bounds index, dropped by the scatter
     flat = jnp.where(pos[:, None] < 0, n_node * F * n_bin, flat)
+    if precision == "fixed":
+        q = jnp.round(gh * FIXED_SCALE).astype(jnp.int32)
+        hist = jnp.zeros((n_node * F * n_bin, 2), dtype=jnp.int32)
+        hist = hist.at[flat].add(q[:, None, :], mode="drop")
+        return hist.reshape(n_node, F, n_bin, 2)
     hist = jnp.zeros((n_node * F * n_bin, 2), dtype=jnp.float32)
     hist = hist.at[flat].add(gh[:, None, :], mode="drop")
     return hist.reshape(n_node, F, n_bin, 2)
 
 
-def node_stats(gh: jax.Array, pos: jax.Array, n_node: int) -> jax.Array:
+def node_stats(gh: jax.Array, pos: jax.Array, n_node: int,
+               precision: str = "auto") -> jax.Array:
     """Per-node (G, H) sums via segment-sum (reference GetNodeStats,
-    ``updater_basemaker-inl.hpp:266-306``).  Returns (n_node, 2)."""
+    ``updater_basemaker-inl.hpp:266-306``).  Returns (n_node, 2) —
+    int32 fixed-point under ``precision="fixed"`` (same contract as
+    :func:`build_level_histogram`: reduce first, then
+    :func:`dequantize_hist`)."""
+    if precision == "fixed":
+        idx = jnp.where(pos < 0, n_node, pos)
+        q = jnp.round(gh * FIXED_SCALE).astype(jnp.int32)
+        out = jnp.zeros((n_node, 2), dtype=jnp.int32)
+        return out.at[idx].add(q, mode="drop")
     if _impl().startswith("pallas"):
         from xgboost_tpu.ops.pallas_hist import node_stats_pallas
         return node_stats_pallas(gh, pos, n_node,

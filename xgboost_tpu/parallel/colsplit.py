@@ -45,8 +45,8 @@ def feature_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
-    return jax.make_mesh((len(devs),), (FEAT_AXIS,), devices=devs,
-                         axis_types=(jax.sharding.AxisType.Auto,))
+    from xgboost_tpu.parallel.mesh import make_mesh
+    return make_mesh((len(devs),), (FEAT_AXIS,), devices=devs)
 
 
 def grow_tree_colsplit(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
@@ -107,7 +107,8 @@ def _colsplit_fn(mesh: Mesh, cfg: GrowConfig, f_local: int, n_shard: int,
     # check_vma=False: every shard derives the SAME tree/row outputs from
     # all-gathered split candidates and psum'd routing bits, but the static
     # varying-manifest analysis cannot see through the argmax/gather chain.
-    return jax.jit(jax.shard_map(
+    from xgboost_tpu.parallel.mesh import shard_map
+    return jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(None, FEAT_AXIS), P(), P(FEAT_AXIS, None),
                   P(FEAT_AXIS), P()),
@@ -243,7 +244,8 @@ def _colsplit_exact_fn(mesh: Mesh, cfg: GrowConfig, f_local: int,
 
     # check_vma=False for the same reason as _colsplit_fn: every shard
     # derives identical outputs from the merged winners + psum'd bits
-    return jax.jit(jax.shard_map(
+    from xgboost_tpu.parallel.mesh import shard_map
+    return jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(None, FEAT_AXIS), P(), P(),
                   P(FEAT_AXIS, None), P(FEAT_AXIS, None)),

@@ -21,7 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from xgboost_tpu.models.tree import (GrowConfig, grow_tree,
                                      table_lookup)
-from xgboost_tpu.parallel.mesh import DATA_AXIS
+from xgboost_tpu.parallel.mesh import DATA_AXIS, shard_map
 
 
 def _psum_data(x):
@@ -50,7 +50,7 @@ def grow_tree_dp(mesh: Mesh, key, binned, gh, cut_values, n_cuts,
         root = jnp.zeros(binned.shape[0], jnp.int32)
     # check_vma=False: the Pallas histogram kernel's out_shape carries no
     # vma annotation, and the psum'd tree outputs are replicated anyway
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS),
                   P(DATA_AXIS)),
@@ -71,7 +71,7 @@ def refresh_tree_dp(mesh: Mesh, tree, binned, gh, split_cfg, max_depth,
         return refresh_tree(tree, binned, gh, split_cfg, max_depth,
                             row_valid, hist_reduce=_psum_data)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(),

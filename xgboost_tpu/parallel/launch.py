@@ -64,7 +64,16 @@ def init_worker(local_device_count: Optional[int] = None) -> bool:
     global mesh (collectives ride ICI within a slice, DCN across).
     """
     coord = os.environ.get(COORD_ENV)
+    if local_device_count is None and os.environ.get("XGBTPU_LOCAL_DEVICES"):
+        local_device_count = int(os.environ["XGBTPU_LOCAL_DEVICES"])
     if not coord:
+        # standalone gang worker (launch_local(standalone=True) exports
+        # no coordinator): still honor the virtual-device request so a
+        # single-controller worker can run the mesh-fused scan over an
+        # in-process multi-device mesh — the live multi-device target
+        # on hosts whose backend cannot execute multi-process programs
+        if local_device_count is not None:
+            _force_local_devices(local_device_count)
         return False
     if RANK_ENV in os.environ:
         n = int(os.environ[NWORKER_ENV])
@@ -81,26 +90,37 @@ def init_worker(local_device_count: Optional[int] = None) -> bool:
                 "(OpenMPI/PMI/Slurm/SGE)")
         rank, sched_n = rw
         n = int(os.environ.get(NWORKER_ENV, sched_n))
-    if local_device_count is None and os.environ.get("XGBTPU_LOCAL_DEVICES"):
-        local_device_count = int(os.environ["XGBTPU_LOCAL_DEVICES"])
     if local_device_count is not None:
-        # CPU workers: give each process a fixed virtual device count
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count="
-                f"{local_device_count}").strip()
+        _force_local_devices(local_device_count)
     import jax
-    if local_device_count is not None:
-        # virtual-CPU testing mode: pin the platform so a co-resident
-        # accelerator plugin (which overrides the JAX_PLATFORMS env var
-        # at import time) cannot become default_backend() and steer
-        # backend-conditional code (e.g. the histogram kernel choice)
-        # at a CPU-device mesh
-        jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=n, process_id=rank)
     return True
+
+
+def _force_local_devices(local_device_count: int) -> None:
+    """Give this process a fixed virtual CPU device count and pin the
+    platform.  Must run before any jax API touches the backend."""
+    # CPU workers: give each process a fixed virtual device count.  An
+    # explicit request REPLACES any inherited count (a parent test
+    # harness or launcher may have exported its own) — the operator
+    # asked for exactly this many devices.
+    import re
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={local_device_count}"
+    if "host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", want, flags)
+    else:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+    import jax
+    # virtual-CPU testing mode: pin the platform so a co-resident
+    # accelerator plugin (which overrides the JAX_PLATFORMS env var
+    # at import time) cannot become default_backend() and steer
+    # backend-conditional code (e.g. the histogram kernel choice)
+    # at a CPU-device mesh
+    jax.config.update("jax_platforms", "cpu")
 
 
 def free_port() -> int:

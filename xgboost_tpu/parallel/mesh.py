@@ -23,6 +23,40 @@ DATA_AXIS = "data"
 _default_mesh: Optional[Mesh] = None
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes it top-level with ``check_vma``; the jax this
+    container bakes in (0.4.x) only has
+    ``jax.experimental.shard_map.shard_map`` with the same semantics
+    under the older ``check_rep`` name.  Every shard_map in the repo
+    goes through here so the mesh path runs LIVE on both (ROADMAP
+    container caveat — the forced-multi-CPU-device tests depend on it).
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def mesh_available(min_devices: int = 2) -> bool:
+    """True when a live data-parallel mesh of ``min_devices`` can run in
+    THIS process: enough devices and a working shard_map (top-level or
+    experimental).  The test skipif gate — prefer a live
+    forced-multi-CPU-device run over a skip wherever possible."""
+    if len(jax.devices()) < min_devices:
+        return False
+    if hasattr(jax, "shard_map"):
+        return True
+    try:
+        from jax.experimental.shard_map import shard_map as _  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
 def set_mesh(mesh: Optional[Mesh]) -> None:
     """Install a process-wide default mesh for dsplit=row training."""
     global _default_mesh
@@ -43,5 +77,16 @@ def data_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
-    return jax.make_mesh((len(devs),), (DATA_AXIS,), devices=devs,
-                         axis_types=(jax.sharding.AxisType.Auto,))
+    return make_mesh((len(devs),), (DATA_AXIS,), devices=devs)
+
+
+def make_mesh(shape, names, devices=None) -> Mesh:
+    """``jax.make_mesh`` with Auto axis types where the jax version has
+    them (older jax predates ``sharding.AxisType`` and is Auto-only —
+    passing the kwarg there is a TypeError)."""
+    kwargs = {}
+    if hasattr(jax.sharding, "AxisType"):
+        kwargs["axis_types"] = tuple(
+            jax.sharding.AxisType.Auto for _ in names)
+    return jax.make_mesh(tuple(shape), tuple(names), devices=devices,
+                         **kwargs)

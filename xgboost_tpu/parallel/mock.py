@@ -167,7 +167,8 @@ def begin_round(version: int) -> None:
         _injector.begin_round(version)
 
 
-def collective(op: str = "allreduce", nbytes: float = 0.0) -> None:
+def collective(op: str = "allreduce", nbytes: float = 0.0,
+               count: int = 1) -> None:
     """Call at every host-side collective entry (tree-growth launch).
 
     Besides the fault-injection seqno, each entry is COUNTED into the
@@ -175,7 +176,13 @@ def collective(op: str = "allreduce", nbytes: float = 0.0) -> None:
     per-round tallies, obs/comm.py) with the caller's logical payload
     estimate — so the exported allreduce count matches this seam's
     seqno space by construction.  Wall seconds are added by the caller
-    timing the launch (``comm.timed(..., count=0)``)."""
+    timing the launch (``comm.timed(..., count=0)``).
+
+    ``count`` lets one seam entry (one injector seqno — one tree-growth
+    launch) record several device collectives: the mesh-fused scan
+    psums one histogram per level, so its growth steps count
+    ``max_depth`` into ``xgbtpu_comm_psum_total`` while staying ONE
+    fault-injection coordinate."""
     global _calls
     _calls += 1
     # record BEFORE the injector can raise: a simulated worker death
@@ -183,7 +190,7 @@ def collective(op: str = "allreduce", nbytes: float = 0.0) -> None:
     # xgbtpu_comm_<op>_total and collective_calls() stay equal even
     # across fault trials
     from xgboost_tpu.obs import comm
-    comm.record(op, nbytes=nbytes)
+    comm.record(op, nbytes=nbytes, count=count)
     if _injector is not None:
         _injector.collective()
 
