@@ -1,0 +1,437 @@
+"""Multi-tenant catalog tests (xgboost_tpu.catalog; SERVING.md catalog
+section).
+
+Acceptance criteria covered here (ISSUE 14):
+(a) per-model bitwise parity: every catalog entry predicts EXACTLY
+    like a standalone engine built from the same file — including two
+    width-divergent models resident on one replica at once;
+(b) budget: admitting past ``serve_catalog_mb`` LRU-evicts the coldest
+    non-default entry, a later request re-admits it, the default entry
+    is pinned, and a hot model's executables survive the churn (zero
+    recompiles, recompile_guard-pinned);
+(c) HTTP surface: ``?model=`` on /predict, bare /predict == the
+    default model, per-model /healthz rows with content hashes,
+    unknown models 404 with the known list;
+(d) model-aware routing: the router learns hosting from
+    registration/heartbeat advertisements and sends ``?model=`` only
+    to hosting replicas;
+(e) tenant isolation: one tenant blowing its token-bucket rate sheds
+    429 while a neighbor's requests all succeed;
+(f) router zero-downtime restart: the membership snapshot round-trips
+    through the CRC-footered state file;
+(g) per-tenant rollout/rollback: pushing tenant A's lane moves A's
+    served hash and leaves B's pinned;
+(h) per-tenant training lanes: one lane erroring never stalls its
+    neighbor, and each lane keeps its own gated-hash ledger.
+"""
+
+import hashlib
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.catalog import (ModelCatalog, TenantQuotas, UnknownModel,
+                                 parse_manifest)
+from xgboost_tpu.fleet import FleetRouter
+from xgboost_tpu.fleet.membership import Membership
+from xgboost_tpu.serving import ModelRegistry, PredictEngine, run_server
+
+
+def _train(seed=0, rounds=3, n_features=6, **params):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(300, n_features).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    p = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.4,
+         "silent": 1, "seed": seed, **params}
+    return xgb.train(p, xgb.DMatrix(X, label=y), rounds), X
+
+
+@pytest.fixture(scope="module")
+def models(tmp_path_factory):
+    """Two width-divergent tenants: 6-feature model a, 4-feature
+    model b (different seeds, depths, and row widths)."""
+    d = tmp_path_factory.mktemp("catalog")
+    bst_a, Xa = _train(seed=0, rounds=3, n_features=6)
+    bst_b, Xb = _train(seed=7, rounds=4, n_features=4, max_depth=2)
+    pa, pb = str(d / "model_a.bin"), str(d / "model_b.bin")
+    bst_a.save_model(pa)
+    bst_b.save_model(pb)
+    return bst_a, bst_b, Xa, Xb, pa, pb
+
+
+def _file_hash(path):
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _registry_factory(**kw):
+    def make(path):
+        reg = ModelRegistry(path, warmup=True, poll_sec=0,
+                            min_bucket=8, max_bucket=16, **kw)
+        return reg
+    return make
+
+
+def _post(url, payload=None, data=None, headers=None):
+    body = (json.dumps(payload).encode() if payload is not None
+            else (data or b""))
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            raw = r.read()
+            return r.status, json.loads(raw)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            return e.code, json.loads(raw)
+        except ValueError:
+            return e.code, {}
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _csv(rows):
+    return "\n".join(",".join(f"{v:.6f}" for v in row)
+                     for row in rows).encode()
+
+
+def _catalog_replica(catalog, router_url="", rid="", port=0, **kw):
+    return run_server("", catalog=catalog, port=port, min_bucket=8,
+                      max_bucket=32, max_wait_ms=1.0, poll_sec=0,
+                      warmup=False, quiet=True, block=False,
+                      router_url=router_url, replica_id=rid, **kw)
+
+
+# ------------------------------------------------------------- manifest
+def test_parse_manifest_inline_and_file(tmp_path):
+    assert parse_manifest("a=./a.bin, b=./b.bin") == {
+        "a": "./a.bin", "b": "./b.bin"}
+    mf = tmp_path / "catalog.conf"
+    mf.write_text("# tenants\na = ./a.bin\nb = ./b.bin\n")
+    assert parse_manifest(str(mf)) == {"a": "./a.bin", "b": "./b.bin"}
+    with pytest.raises(ValueError):
+        parse_manifest("a=,b=./b.bin")
+
+
+# ----------------------------------------------------- quotas (units)
+def test_tenant_quotas_inflight_and_rate():
+    q = TenantQuotas(inflight_limit=1, rate=0.0)
+    assert q.try_admit("a") is None
+    assert q.try_admit("a") == "inflight"   # 503: budget is per tenant
+    assert q.try_admit("b") is None         # neighbor unaffected
+    q.release("a")
+    assert q.try_admit("a") is None
+    q.release("a")
+    q.release("b")
+    # token bucket: burst tokens up front, then the sustained rate
+    q = TenantQuotas(rate=1.0, burst=2.0)
+    assert q.try_admit("a") is None and q.try_admit("a") is None
+    assert q.try_admit("a") == "rate"       # bucket drained -> 429
+    assert q.try_admit("b") is None         # b's bucket is its own
+    assert not TenantQuotas().enabled and TenantQuotas(rate=1.0).enabled
+
+
+# ------------------------------------------------------------- parity
+def test_catalog_bitwise_parity_width_divergent(models):
+    """(a) two width-divergent tenants resident at once, each bitwise
+    equal to a standalone engine on the same file."""
+    bst_a, bst_b, Xa, Xb, pa, pb = models
+    cat = ModelCatalog(registry_factory=_registry_factory())
+    cat.add_model("a", pa)
+    cat.add_model("b", pb)
+    ea = cat.resolve("a").registry.engine
+    eb = cat.resolve("b").registry.engine
+    assert ea.num_feature == 6 and eb.num_feature == 4
+    ref_a = PredictEngine(pa, min_bucket=8, max_bucket=16)
+    ref_b = PredictEngine(pb, min_bucket=8, max_bucket=16)
+    for n in (1, 7, 16, 33):
+        Qa = np.random.RandomState(n).rand(n, 6).astype(np.float32)
+        Qb = np.random.RandomState(n).rand(n, 4).astype(np.float32)
+        assert np.array_equal(ea.predict(Qa), ref_a.predict(Qa))
+        assert np.array_equal(eb.predict(Qb), ref_b.predict(Qb))
+        # and vs the boosters themselves
+        assert np.array_equal(ea.predict(Qa),
+                              bst_a.predict(xgb.DMatrix(Qa)))
+        assert np.array_equal(eb.predict(Qb),
+                              bst_b.predict(xgb.DMatrix(Qb)))
+    # bare resolve is the default (first-added) model
+    assert cat.resolve().name == "a"
+    with pytest.raises(UnknownModel) as ei:
+        cat.resolve("zzz")
+    assert ei.value.known == ["a", "b"]
+    cat.stop()
+
+
+# ------------------------------------------------------------- budget
+def test_catalog_eviction_readmit_default_pinned(models, recompile_guard):
+    """(b) budget enforcement: LRU eviction of the coldest non-default
+    entry, on-demand re-admission, the default pinned, and the hot
+    default's executables untouched by the churn."""
+    _, _, Xa, _, pa, pb = models
+    cat = ModelCatalog(hysteresis_sec=0.0,
+                       registry_factory=_registry_factory())
+    cat.add_model("d", pa)
+    cat.add_model("a", pa)
+    cat.add_model("b", pb)
+    assert cat.default == "d"
+    ed = cat.resolve("d")
+    cat.resolve("a")
+    time.sleep(0.01)  # strict LRU order: a older than b below
+    # freeze the budget at exactly the current residency: admitting
+    # one more model must evict one (the coldest non-default)
+    cat.budget_bytes = cat.bytes_used()
+    cat.resolve("b")
+    assert not cat.get("a").resident, "coldest entry survived the budget"
+    assert cat.get("b").resident and cat.get("d").resident
+    assert cat.bytes_used() <= cat.budget_bytes
+    time.sleep(0.01)
+    # re-admission on demand; now b is the coldest and sheds
+    ra = cat.resolve("a")
+    assert ra.resident and not cat.get("b").resident
+    assert cat.get("a").admissions == 2 and cat.get("a").evictions == 1
+    # an evicted entry still advertises the hash it would serve
+    assert cat.models()["b"]["hash"] == cat.get("b").last_hash is not None
+    # the default survived every enforcement pass...
+    assert cat.get("d").evictions == 0
+    # ...and its hot engine never recompiled across the churn
+    with recompile_guard.expect(0):
+        ed.registry.engine.predict(Xa[:8].astype(np.float32))
+    cat.stop()
+
+
+def test_catalog_hysteresis_blocks_thrash(models):
+    """(b) entries inside the hysteresis window are not evictable: a
+    fully-hot over-budget catalog sits over budget instead of
+    thrashing its working set."""
+    _, _, _, _, pa, pb = models
+    cat = ModelCatalog(hysteresis_sec=60.0,
+                       registry_factory=_registry_factory())
+    cat.add_model("a", pa)
+    cat.add_model("b", pb)
+    cat.resolve("a")
+    cat.budget_bytes = 1  # everything is over budget now
+    cat.resolve("b")
+    assert cat.get("a").resident and cat.get("b").resident
+    cat.stop()
+
+
+# ---------------------------------------------------------------- http
+def test_http_multi_model_healthz_and_404(models):
+    """(c) ?model= serving, default resolution, per-model healthz rows
+    with content hashes, unknown-model 404."""
+    bst_a, bst_b, Xa, Xb, pa, pb = models
+    srv = _catalog_replica(f"a={pa},b={pb}")
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        Qa, Qb = np.round(Xa[:5], 6), np.round(Xb[:5], 6)
+        st, ra = _post(base + "/predict?model=a", data=_csv(Qa))
+        assert st == 200 and ra["model"] == "a" and ra["rows"] == 5
+        assert np.allclose(ra["predictions"],
+                           bst_a.predict(xgb.DMatrix(Qa)), atol=1e-6)
+        st, rb = _post(base + "/predict?model=b", data=_csv(Qb))
+        assert st == 200 and rb["model"] == "b"
+        assert np.allclose(rb["predictions"],
+                           bst_b.predict(xgb.DMatrix(Qb)), atol=1e-6)
+        # bare /predict is the default model (the catalog-of-one path)
+        st, rd = _post(base + "/predict", data=_csv(Qa))
+        assert st == 200 and rd["predictions"] == ra["predictions"]
+        # unknown model: 404 naming the catalog, request never parsed
+        st, err = _post(base + "/predict?model=zzz", data=_csv(Qa))
+        assert st == 404 and err["models"] == ["a", "b"]
+        h = _get(base + "/healthz")
+        assert h["catalog"]["default"] == "a"
+        assert h["catalog"]["configured"] == 2
+        assert h["models"]["a"]["model_hash"] == _file_hash(pa)
+        assert h["models"]["b"]["model_hash"] == _file_hash(pb)
+        # per-model request attribution on /metrics
+        mtext = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert 'xgbtpu_catalog_requests_total{model="b"}' in mtext
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------- routing
+def test_router_model_aware_routing(models, tmp_path):
+    """(d) the router learns hosting sets from advertisements and
+    dispatches ?model= only to hosting replicas."""
+    bst_a, bst_b, Xa, Xb, pa, pb = models
+    rt = FleetRouter(port=0, hc_sec=0, quiet=True).start()
+    base = f"http://{rt.host}:{rt.port}"
+    s1 = _catalog_replica(f"a={pa}", router_url=base, rid="r1")
+    s2 = _catalog_replica(f"b={pb}", router_url=base, rid="r2")
+    try:
+        assert rt.membership.models_hosted() == {"a": 1, "b": 1}
+        assert rt.membership.hosting("a") == {"r1"}
+        Qa, Qb = np.round(Xa[:3], 6), np.round(Xb[:3], 6)
+        for _ in range(3):  # every dispatch lands on the hosting replica
+            st, ra = _post(base + "/predict?model=a", data=_csv(Qa))
+            assert st == 200
+            assert np.allclose(ra["predictions"],
+                               bst_a.predict(xgb.DMatrix(Qa)), atol=1e-6)
+            st, rb = _post(base + "/predict?model=b", data=_csv(Qb))
+            assert st == 200
+            assert np.allclose(rb["predictions"],
+                               bst_b.predict(xgb.DMatrix(Qb)), atol=1e-6)
+        # a model nobody hosts: 404 with the fleet's hosting map
+        st, err = _post(base + "/predict?model=zzz", data=_csv(Qa))
+        assert st == 404 and err["models"] == ["a", "b"]
+        # by-id ownership is per (model, entity): both tenants resolve
+        st, _ = _post(base + "/predict_by_id?model=a",
+                      payload={"ids": ["e1"],
+                               "rows": [Qa[0].tolist()]})
+        assert st in (200, 404)  # 404 = featurestore disabled, routed OK
+    finally:
+        s1.shutdown()
+        s2.shutdown()
+        rt.shutdown()
+
+
+def test_router_tenant_quota_isolated_shed(models):
+    """(e) tenant a blowing its rate budget sheds 429; every one of
+    tenant b's requests succeeds untouched."""
+    bst_a, bst_b, Xa, Xb, pa, pb = models
+    rt = FleetRouter(port=0, hc_sec=0, tenant_rate=1.0, tenant_burst=3.0,
+                     quiet=True).start()
+    base = f"http://{rt.host}:{rt.port}"
+    srv = _catalog_replica(f"a={pa},b={pb}", router_url=base, rid="r1")
+    try:
+        Qa, Qb = np.round(Xa[:2], 6), np.round(Xb[:2], 6)
+        a_codes = [_post(base + "/predict?model=a", data=_csv(Qa))[0]
+                   for _ in range(8)]
+        assert a_codes.count(200) >= 1 and a_codes.count(429) >= 3
+        # b interleaves AFTER a's bucket is drained and still succeeds
+        for _ in range(3):
+            st, rb = _post(base + "/predict?model=b", data=_csv(Qb))
+            assert st == 200
+            assert np.allclose(rb["predictions"],
+                               bst_b.predict(xgb.DMatrix(Qb)), atol=1e-6)
+        mtext = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert 'xgbtpu_tenant_shed_total{model="a"}' in mtext
+        assert 'xgbtpu_tenant_shed_total{model="b"}' not in mtext
+    finally:
+        srv.shutdown()
+        rt.shutdown()
+
+
+# ---------------------------------------------------- snapshot/restore
+def test_membership_snapshot_roundtrip():
+    """(f) the snapshot carries identity + model advertisements; the
+    restore grants fresh leases and rebuilds hosting sets."""
+    m = Membership(lease_sec=30.0)
+    m.register("r1", "http://h:1", model_path="/m1",
+               models={"a": {"path": "/m1", "hash": "h1"}})
+    m.register("r2", "http://h:2", models={"b": {"path": "/m2"}})
+    m2 = Membership(lease_sec=30.0)
+    assert m2.restore(m.snapshot()) == 2
+    assert m2.ids() == m.ids()
+    assert m2.hosting("a") == {"r1"} and m2.hosting("b") == {"r2"}
+    assert m2.get("r1").url == "http://h:1"
+    # garbage state restores nothing instead of raising
+    assert Membership().restore({"replicas": [{"bogus": 1}]}) == 0
+
+
+def test_router_restart_restores_membership(tmp_path):
+    """(f) a router restarted on the same state file comes back with
+    its replica set — traffic flows without any re-registration."""
+    state = str(tmp_path / "fleet.state")
+    rt = FleetRouter(port=0, hc_sec=0, state_path=state, quiet=True).start()
+    base = f"http://{rt.host}:{rt.port}"
+    from tests.test_fleet import _Stub
+    stub = _Stub()
+    try:
+        st, _ = _post(base + "/fleet/register",
+                      {"replica_id": "r1", "url": stub.url,
+                       "models": {"a": {"path": "/m", "hash": "h"}}})
+        assert st == 200 and os.path.exists(state)
+        rt.shutdown()
+        rt2 = FleetRouter(port=0, hc_sec=0, state_path=state,
+                          quiet=True).start()
+        try:
+            assert rt2.membership.ids() == ["r1"]
+            assert rt2.membership.hosting("a") == {"r1"}
+            base2 = f"http://{rt2.host}:{rt2.port}"
+            st, js = _post(base2 + "/predict", data=b"0.5")
+            assert st == 200 and js["predictions"] == [0.5]
+        finally:
+            rt2.shutdown()
+    finally:
+        stub.close()
+
+
+# ------------------------------------------------- per-tenant rollout
+def test_per_tenant_rollout_and_rollback(models, tmp_path):
+    """(g) rolling out tenant a's lane moves a's served hash; tenant
+    b's stays pinned through the rollout AND the rollback."""
+    bst_a, _, Xa, _, _, pb = models
+    # private copies: the rollout rewrites the replica's model files
+    pa2 = str(tmp_path / "a.bin")
+    pb2 = str(tmp_path / "b.bin")
+    bst_a.save_model(pa2)
+    with open(pb, "rb") as f:
+        with open(pb2, "wb") as g:
+            g.write(f.read())
+    hash_a0, hash_b0 = _file_hash(pa2), _file_hash(pb2)
+    bst_a2, _ = _train(seed=3, rounds=2, n_features=6)
+    staged = str(tmp_path / "a_next.bin")
+    bst_a2.save_model(staged)
+    rt = FleetRouter(port=0, hc_sec=0, quiet=True).start()
+    base = f"http://{rt.host}:{rt.port}"
+    srv = _catalog_replica(f"a={pa2},b={pb2}", router_url=base, rid="r1")
+    try:
+        rbase = f"http://127.0.0.1:{srv.port}"
+        st, report = _post(base + "/fleet/rollout",
+                           {"model_path": staged, "model": "a",
+                            "soak_sec": 0.1})
+        assert st == 200 and report["status"] == "ok", report
+        assert report["model"] == "a"
+        h = _get(rbase + "/healthz")
+        assert (h["models"]["a"]["model_hash"]
+                == _file_hash(staged) != hash_a0)
+        assert h["models"]["b"]["model_hash"] == hash_b0, "b's lane moved"
+        # targeted rollback: a returns to its prior content, b pinned
+        st, rb = _post(base + "/fleet/rollback", {"model": "a"})
+        assert st == 200 and rb["model"] == "a"
+        h = _get(rbase + "/healthz")
+        assert h["models"]["a"]["model_hash"] == hash_a0
+        assert h["models"]["b"]["model_hash"] == hash_b0
+        assert _file_hash(pa2) == hash_a0  # file restored too
+    finally:
+        srv.shutdown()
+        rt.shutdown()
+
+
+# ------------------------------------------------------- tenant lanes
+def test_tenant_lanes_isolated(tmp_path):
+    """(h) two concurrent training lanes: one misconfigured lane errors
+    out; the neighbor still publishes, with its own gated ledger."""
+    from xgboost_tpu.pipeline import SyntheticDataSource, run_tenant_lanes
+    pub_b = str(tmp_path / "b" / "model.bin")
+    os.makedirs(os.path.dirname(pub_b))
+    params = {"objective": "binary:logistic", "max_depth": 2, "silent": 1}
+    out = run_tenant_lanes({
+        # lane a: no data source at all -> ValueError inside the lane
+        "a": {"publish_path": str(tmp_path / "a" / "model.bin"),
+              "workdir": str(tmp_path / "a-work"), "params": params},
+        "b": {"publish_path": pub_b,
+              "workdir": str(tmp_path / "b-work"),
+              "source": SyntheticDataSource(n_rows=200, n_features=5),
+              "rounds_per_cycle": 2, "cycles": 1, "params": params},
+    }, quiet=True)
+    assert out["a"]["status"] == "error"
+    assert out["b"]["status"] == "ok"
+    assert out["b"]["summary"]["published"] == 1
+    # b's own fsync'd ledger names exactly the bytes at its publish path
+    ledger = open(os.path.join(str(tmp_path / "b-work"),
+                               "gated.log")).read().split()
+    assert ledger[-1] == _file_hash(pub_b)
+    assert not os.path.exists(str(tmp_path / "a" / "model.bin"))

@@ -17,6 +17,11 @@ Cells:
 - ``router_1`` / ``router_3`` — clients -> router -> fleet.
 - ``overload``       — router in-flight budget dropped to force load
   shedding; reports the shed rate and asserts zero NON-shed failures.
+- ``catalog_1`` / ``catalog_4`` (``--catalog-only``) — one replica
+  serving a 1-entry vs a 4-entry model catalog
+  (``task=serve catalog=...``, xgboost_tpu.catalog) over the same
+  wire, the 4-entry cell hammered by all four tenants CONCURRENTLY
+  with per-tenant req/s and p99.
 
 Note this container is 1-CPU: replica parallelism cannot exceed one
 core, so ``router_3`` measures dispatch/retry overhead and shedding
@@ -76,7 +81,7 @@ def _bodies(n: int = 64):
 
 
 def hammer(base_url: str, total_reqs: int, clients: int,
-           deadline_ms=None):
+           deadline_ms=None, path: str = "/predict"):
     """``clients`` threads, keep-alive connections, 1-row posts
     (retry-once semantics live in launch_fleet.RetryingPredictClient).
     Returns aggregate stats + per-request outcome counts.
@@ -98,7 +103,7 @@ def hammer(base_url: str, total_reqs: int, clients: int,
     barrier = threading.Barrier(clients + 1)
 
     def client(ci: int):
-        conn = RetryingPredictClient(base_url)
+        conn = RetryingPredictClient(base_url, path=path)
         mine = dict.fromkeys(counts, 0)
         mylat = []
         details = []
@@ -206,10 +211,125 @@ def deadline_only() -> int:
     return 0 if feasible["failures"] + tight["failures"] == 0 else 1
 
 
+def catalog_only() -> int:
+    """Run ONLY the catalog cells — one replica serving a 1-entry vs a
+    4-entry model catalog over the same wire — and merge them into the
+    committed BENCH_fleet.json (the other cells stay untouched).  The
+    4-entry cell drives all four tenants concurrently: the number that
+    matters is how much a busy multi-tenant replica costs each tenant
+    vs having the replica to itself."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    work = tempfile.mkdtemp(prefix="xgbtpu_benchcat_")
+    print("[bench_fleet] training model...", file=sys.stderr)
+    names = ["m0", "m1", "m2", "m3"]
+    paths = {n: os.path.join(work, f"{n}.bin") for n in names}
+    _train_model(paths["m0"])
+    for n in names[1:]:
+        shutil.copyfile(paths["m0"], paths[n])
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def replica(manifest):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        log = open(os.path.join(work, f"replica-{port}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "xgboost_tpu", "task=serve",
+             f"catalog={manifest}", f"serve_port={port}",
+             "serve_host=127.0.0.1", "silent=1"] + SERVE_ARGS,
+            stdout=log, stderr=log, cwd=repo,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        log.close()
+        url = f"http://127.0.0.1:{port}"
+        deadline = time.perf_counter() + 300.0
+        while time.perf_counter() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"catalog replica died rc={proc.returncode} "
+                    f"(see {work}/replica-{port}.log)")
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=2) as r:
+                    json.load(r)
+                return proc, url
+            except (OSError, ValueError):
+                time.sleep(0.25)
+        proc.kill()
+        raise TimeoutError("catalog replica never became healthy")
+
+    print("[bench_fleet] catalog_1 (one resident model)...",
+          file=sys.stderr)
+    proc, url = replica(f"m0={paths['m0']}")
+    cat1 = hammer(url, REQS, CLIENTS, path="/predict?model=m0")
+    proc.terminate()
+    proc.wait()
+
+    print("[bench_fleet] catalog_4 (four resident models, "
+          "concurrent tenants)...", file=sys.stderr)
+    proc, url = replica(",".join(f"{n}={paths[n]}" for n in names))
+    per = {}
+    lock = threading.Lock()
+
+    def tenant(n):
+        cell = hammer(url, REQS // len(names),
+                      max(2, CLIENTS // len(names)),
+                      path=f"/predict?model={n}")
+        with lock:
+            per[n] = cell
+
+    ts = [threading.Thread(target=tenant, args=(n,)) for n in names]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    proc.terminate()
+    proc.wait()
+
+    cat4 = {
+        "tenants": len(names),
+        "requests": sum(c["requests"] for c in per.values()),
+        "requests_per_sec": round(
+            sum(c["requests"] for c in per.values()) / wall, 1),
+        "ok": sum(c["ok"] for c in per.values()),
+        "failures": sum(c["failures"] for c in per.values()),
+        "p99_ms_worst_tenant": max(c["p99_ms"] for c in per.values()),
+        "per_tenant": per,
+    }
+    if (os.cpu_count() or 1) <= 2:
+        cat4["note"] = (
+            f"{os.cpu_count()}-core container: all four tenant engines "
+            "share one core, so catalog_4 measures multi-model "
+            "interleaving fairness and per-tenant isolation overhead, "
+            "not parallel speedup — aggregate req/s stays near "
+            "catalog_1 while per-tenant p99 grows with the sharing")
+    try:
+        with open(_bench_path()) as f:
+            out = json.load(f)
+    except OSError:
+        out = {}
+    out["catalog_1"] = cat1
+    out["catalog_4"] = cat4
+    with open(_bench_path(), "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"catalog_1": cat1, "catalog_4": cat4}))
+    return 0 if cat1["failures"] + cat4["failures"] == 0 else 1
+
+
 def main():
     import tempfile
     if "--deadline-only" in sys.argv[1:]:
         return deadline_only()
+    if "--catalog-only" in sys.argv[1:]:
+        return catalog_only()
     work = tempfile.mkdtemp(prefix="xgbtpu_benchfleet_")
     model = os.path.join(work, "model.bin")
     print("[bench_fleet] training model...", file=sys.stderr)

@@ -40,6 +40,21 @@ serves must be the initial seed model or a hash recorded in the
 pipeline's fsync'd ``gated.log`` ledger BEFORE its publish began.
 Emits ``PIPELINE_CHAOS.json``.
 
+``--catalog`` switches to the MULTI-TENANT catalog chaos mode
+(SERVING.md catalog section): two width-divergent tenant models share a
+catalog fleet (``task=serve catalog=a=...,b=...``) behind a router
+subprocess running with ``fleet_state_path``; per-tenant ``task=
+pipeline`` lanes train→gate→publish against each tenant's publish
+path while per-tenant clients drive ``/predict?model=...`` and the
+killer SIGKILLs lane trainers at random — and the ROUTER itself, whose
+replacement must restore membership from the CRC-footered snapshot
+with zero non-shed client failures.  Per-tenant hash watchers scrape
+``/healthz`` ``models`` rows straight off every replica; the contract
+is the pipeline mode's zero-ungated-models invariant enforced PER
+TENANT (each tenant against its OWN ``gated.log``), plus isolation:
+killing one tenant's trainer never stalls the other's lane.  Emits
+``CATALOG_CHAOS.json``.
+
 ``--train`` switches to the STALL-failure training mode (RELIABILITY.md
 stall matrix): each run arms a ``stall`` mock coordinate (the hang twin
 of worker death, parallel/mock.py) — and, half the time, a death
@@ -619,6 +634,322 @@ def pipeline_mode(args) -> int:
     return 0 if ok else 1
 
 
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def catalog_mode(args) -> int:
+    """Multi-tenant catalog chaos: two width-divergent tenants share a
+    catalog fleet while per-tenant training lanes publish, a killer
+    SIGKILLs lane trainers at random AND the router itself (which must
+    restart from its membership snapshot with zero non-shed client
+    failures).  Contract: the zero-ungated-models invariant holds PER
+    TENANT, and killing one tenant's trainer never stalls the other."""
+    import hashlib
+    import subprocess
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import xgboost_tpu as xgb
+
+    work = args.workdir or tempfile.mkdtemp(prefix="xgbtpu_chaoscat_")
+    os.makedirs(work, exist_ok=True)
+    rng = np.random.RandomState(args.seed)
+    cycles = args.pipe_cycles
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    # width-DIVERGENT tenants: different feature counts force different
+    # compiled buckets, so cross-tenant bleed would be loud
+    tenants = {"a": (6, 7), "b": (4, 21)}  # name -> (features, seed)
+    pub, wd, init_hash, body = {}, {}, {}, {}
+    for t, (nf, seed) in tenants.items():
+        _write_libsvm(os.path.join(work, f"holdout-{t}.libsvm"),
+                      n=400, f=nf, seed=900 + nf)
+        for c in range(cycles):
+            _write_libsvm(os.path.join(work, f"fresh-{t}-{c}.libsvm"),
+                          n=400, f=nf, seed=seed * 100 + c)
+        X0 = np.random.RandomState(seed).rand(400, nf).astype(np.float32)
+        y0 = (X0[:, 0] > 0.5).astype(np.float32)
+        pub[t] = os.path.join(work, f"published-{t}.model")
+        xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                   "eta": 0.4, "silent": 1},
+                  xgb.DMatrix(X0, label=y0), 3).save_model(pub[t])
+        with open(pub[t], "rb") as f:
+            init_hash[t] = hashlib.sha256(f.read()).hexdigest()
+        wd[t] = os.path.join(work, f"wd-{t}")
+        body[t] = ",".join(f"{v:.6f}" for v in X0[0]).encode()
+
+    # the router is a SUBPROCESS here (unlike the other fleet modes):
+    # the chaos menu includes SIGKILLing it, and the restart must
+    # rebuild membership from the CRC-footered fleet_state_path snapshot
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    state_path = os.path.join(work, "router.state")
+    router_cmd = [sys.executable, "-m", "xgboost_tpu",
+                  "task=fleet_router", "fleet_host=127.0.0.1",
+                  f"fleet_port={port}", "fleet_lease_sec=3.0",
+                  "fleet_hc_sec=0.5", f"fleet_state_path={state_path}",
+                  "silent=1"]
+
+    def spawn_router():
+        log = open(os.path.join(work, "router.log"), "ab")
+        p = subprocess.Popen(router_cmd, stdout=log, stderr=log,
+                             cwd=repo, env=env)
+        log.close()
+        return p
+
+    manifest = ",".join(f"{t}={pub[t]}" for t in tenants)
+    replicas = {}
+
+    def spawn_replica(i):
+        log = open(os.path.join(work, f"replica-{i}.log"), "ab")
+        replicas[i] = subprocess.Popen(
+            [sys.executable, "-m", "xgboost_tpu", "task=serve",
+             f"catalog={manifest}", "serve_port=0",
+             "serve_host=127.0.0.1", f"serve_router_url={url}",
+             f"serve_replica_id=c{i}", "serve_min_bucket=8",
+             "serve_max_bucket=32", "serve_max_wait_ms=1.0",
+             "serve_poll_sec=0.25", "silent=1"],
+            stdout=log, stderr=log, cwd=repo, env=env)
+        log.close()
+
+    def wait_members(n, timeout=180.0):
+        deadline = time.perf_counter() + timeout
+        got = 0
+        while time.perf_counter() < deadline:
+            try:
+                with urllib.request.urlopen(url + "/fleet/members",
+                                            timeout=5) as r:
+                    mem = json.load(r)
+                got = mem["in_rotation"]
+                if got >= n:
+                    return mem
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.25)
+        raise TimeoutError(f"catalog fleet not ready: {got}/{n} "
+                           f"(see {work}/replica-*.log)")
+
+    router = spawn_router()
+    n_reps = args.fleet_replicas
+    for i in range(n_reps):
+        spawn_replica(i)
+    try:
+        print(f"[chaos-cat] waiting for {n_reps} catalog replicas...",
+              file=sys.stderr)
+        replica_urls = [m["url"]
+                        for m in wait_members(n_reps)["replicas"]]
+    except BaseException:
+        for p in list(replicas.values()) + [router]:
+            p.kill()
+        raise
+
+    observed = {t: set() for t in tenants}
+    counts = {t: {"ok": 0, "shed": 0, "fail": 0} for t in tenants}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def watcher():
+        # per-tenant witness: which hash is each replica serving FOR
+        # EACH MODEL, sampled straight off the replicas (router-down
+        # windows must not blind the contract)
+        while not stop.is_set():
+            for u in replica_urls:
+                try:
+                    with urllib.request.urlopen(u + "/healthz",
+                                                timeout=2) as r:
+                        rows = json.load(r).get("models", {})
+                except (OSError, ValueError):
+                    continue
+                with lock:
+                    for t in tenants:
+                        h = (rows.get(t) or {}).get("model_hash")
+                        if h:
+                            observed[t].add(h)
+            time.sleep(0.05)
+
+    def post(path, data, patience=60.0):
+        # transport failures retry until the patience deadline: a
+        # SIGKILL'd router is allowed a restart window, but every
+        # request must STILL end in a 200 or an explicit shed
+        deadline = time.perf_counter() + patience
+        while True:
+            req = urllib.request.Request(url + path, data=data)
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+                    return 200
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+            except OSError:
+                if time.perf_counter() >= deadline:
+                    return None
+                time.sleep(0.2)
+
+    def client(t):
+        mine = {"ok": 0, "shed": 0, "fail": 0}
+        while not stop.is_set():
+            status = post(f"/predict?model={t}", body[t])
+            mine["ok" if status == 200
+                 else "shed" if status in (429, 503, 504)
+                 else "fail"] += 1
+        with lock:
+            for k in mine:
+                counts[t][k] += mine[k]
+
+    threads = [threading.Thread(target=watcher)] + [
+        threading.Thread(target=client, args=(t,)) for t in tenants]
+    for t_ in threads:
+        t_.start()
+
+    def cursor(t):
+        try:
+            with open(os.path.join(wd[t], "state.json")) as f:
+                return int(json.load(f).get("cycle", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def lane_cmd(t, remaining):
+        data = os.path.join(work, "fresh-" + t + "-{cycle}.libsvm")
+        return [sys.executable, "-m", "xgboost_tpu", "task=pipeline",
+                f"pipeline_publish_path={pub[t]}",
+                f"pipeline_dir={wd[t]}", f"pipeline_data={data}",
+                f"pipeline_holdout={os.path.join(work, f'holdout-{t}.libsvm')}",
+                "pipeline_rounds_per_cycle=3",
+                "pipeline_max_regression=0.2",
+                f"pipeline_cycles={remaining}",
+                "objective=binary:logistic", "max_depth=3", "eta=0.4",
+                "silent=1"]
+
+    fault_menu = [None, None, None,
+                  "bit_flip=256@candidate.model",
+                  "torn_write=128@candidate.model",
+                  "read_flip=64@published-"]
+    lanes = {}
+    lane_logs = {t: open(os.path.join(work, f"pipeline-{t}.log"), "ab")
+                 for t in tenants}
+    kills = router_kills = attempts = faults_armed = 0
+    router_restart_sec = None
+    max_attempts = 8 + cycles * 6
+    try:
+        while (attempts < max_attempts
+               and any(cursor(t) < cycles for t in tenants)):
+            for t in tenants:
+                p = lanes.get(t)
+                if cursor(t) >= cycles or (p is not None
+                                           and p.poll() is None):
+                    continue
+                attempts += 1
+                lenv = dict(env)
+                fault = fault_menu[rng.randint(len(fault_menu))]
+                if fault:
+                    lenv["XGBTPU_FAULTS"] = fault
+                    faults_armed += 1
+                lanes[t] = subprocess.Popen(
+                    lane_cmd(t, cycles - cursor(t)),
+                    stdout=lane_logs[t], stderr=lane_logs[t],
+                    cwd=repo, env=lenv)
+                print(f"[chaos-cat] lane {t} attempt (fault={fault}, "
+                      f"cursor={cursor(t)})", file=sys.stderr)
+            time.sleep(float(rng.uniform(8.0, 20.0)))
+            live = [t for t, p in lanes.items()
+                    if p is not None and p.poll() is None]
+            if live and (kills == 0 or rng.rand() < 0.7):
+                # first opportunity always kills (the lane-kill leg is
+                # part of the contract); later windows roll the dice
+                t = live[rng.randint(len(live))]
+                lanes[t].kill()
+                lanes[t].wait()
+                kills += 1
+                print(f"[chaos-cat] SIGKILL lane {t} "
+                      f"(cursor={cursor(t)})", file=sys.stderr)
+            if router_kills == 0 and attempts >= 2:
+                # the router restart leg: SIGKILL the front door under
+                # live traffic; the replacement restores membership
+                # from the snapshot and clients ride through on retry
+                router.kill()
+                router.wait()
+                router_kills += 1
+                t0 = time.perf_counter()
+                router = spawn_router()
+                wait_members(n_reps)
+                router_restart_sec = round(time.perf_counter() - t0, 2)
+                print(f"[chaos-cat] router SIGKILL -> restored "
+                      f"{n_reps} members in {router_restart_sec}s",
+                      file=sys.stderr)
+        # let the replica pollers observe the final publishes
+        time.sleep(1.5)
+    finally:
+        stop.set()
+        for t_ in threads:
+            t_.join(90.0)
+        for p in list(lanes.values()) + list(replicas.values()):
+            if p.poll() is None:
+                p.terminate()
+        if router.poll() is None:
+            router.terminate()
+        for p in list(lanes.values()) + list(replicas.values()) + [router]:
+            try:
+                p.wait(20.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in lane_logs.values():
+            f.close()
+
+    per_tenant = {}
+    total_fail = 0
+    for t in tenants:
+        gated = set()
+        try:
+            with open(os.path.join(wd[t], "gated.log")) as f:
+                gated = {parts[1] for parts in
+                         (line.split() for line in f) if len(parts) >= 2}
+        except OSError:
+            pass
+        violations = sorted(observed[t] - (gated | {init_hash[t]}))
+        total_fail += counts[t]["fail"]
+        per_tenant[t] = {
+            "cycles_completed": cursor(t),
+            "gated_hashes": len(gated),
+            "observed_hashes": len(observed[t]),
+            "published_observed": len(observed[t] & gated),
+            "ungated_observed": len(violations),
+            "violations": violations, **counts[t]}
+    report = {
+        "mode": "catalog", "cycles": cycles,
+        "replicas": n_reps, "attempts": attempts, "kills": kills,
+        "router_kills": router_kills,
+        "router_restart_sec": router_restart_sec,
+        "faults_armed": faults_armed,
+        "tenants": per_tenant, "non_shed_failures": total_fail}
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    done = all(per_tenant[t]["cycles_completed"] >= cycles
+               for t in tenants)
+    clean = all(not per_tenant[t]["violations"]
+                and per_tenant[t]["ok"] > 0
+                and per_tenant[t]["published_observed"] >= 1
+                for t in tenants)
+    print(f"[chaos-cat] cycles "
+          + "/".join(f"{t}:{per_tenant[t]['cycles_completed']}"
+                     for t in tenants)
+          + f", {kills} lane kills, {router_kills} router kills, "
+          f"{total_fail} non-shed failures -> {args.out}",
+          file=sys.stderr)
+    ok = (done and clean and total_fail == 0
+          and kills >= 1 and router_kills >= 1)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--runs", type=int, default=10)
@@ -660,15 +991,26 @@ def main(argv=None) -> int:
                          "the train→gate→publish→reload boundary under "
                          "live fleet traffic (see module docstring)")
     ap.add_argument("--pipe-cycles", type=int, default=4,
-                    help="--pipeline: cycles the pipeline must complete")
+                    help="--pipeline/--catalog: cycles each pipeline "
+                         "(lane) must complete")
+    ap.add_argument("--catalog", action="store_true",
+                    help="multi-tenant catalog mode: two width-"
+                         "divergent tenants on a catalog fleet, "
+                         "per-tenant training lanes, SIGKILLs of lane "
+                         "trainers AND the router (snapshot restart); "
+                         "per-tenant zero-ungated contract "
+                         "(CATALOG_CHAOS.json; see module docstring)")
     args = ap.parse_args(argv)
     if args.out is None:
-        args.out = ("PIPELINE_CHAOS.json" if args.pipeline
+        args.out = ("CATALOG_CHAOS.json" if args.catalog
+                    else "PIPELINE_CHAOS.json" if args.pipeline
                     else "CHAOS_fleet_slow.json"
                     if args.fleet and args.slow
                     else "CHAOS_fleet.json" if args.fleet
                     else "TRAIN_CHAOS.json" if args.train
                     else "CHAOS.json")
+    if args.catalog:
+        return catalog_mode(args)
     if args.pipeline:
         return pipeline_mode(args)
     if args.fleet:

@@ -48,12 +48,14 @@ class RetryingPredictClient:
     transport failure is a REAL failure.  Non-200 responses close the
     connection (the server does too) and reconnect lazily."""
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 path: str = "/predict"):
         import http.client
         from urllib.parse import urlparse
         p = urlparse(base_url)
         self._host, self._port = p.hostname, p.port
         self._timeout = timeout
+        self._path = path  # e.g. "/predict?model=b" for catalog tenants
         self._http = http.client
         self._conn = self._connect()
 
@@ -67,7 +69,7 @@ class RetryingPredictClient:
         response-body excerpt in detail; 200 -> (200, None)."""
         for attempt in range(2):
             try:
-                self._conn.request("POST", "/predict", body=body,
+                self._conn.request("POST", self._path, body=body,
                                    headers=headers or {})
                 r = self._conn.getresponse()
                 out = r.read()

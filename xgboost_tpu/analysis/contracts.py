@@ -369,7 +369,8 @@ def _collect_param_table(ctx: FileContext, node, facts: Facts) -> None:
     else:
         return
     table = {"SERVE_PARAMS": "serve", "FLEET_PARAMS": "fleet",
-             "PIPELINE_PARAMS": "pipeline"}.get(name)
+             "PIPELINE_PARAMS": "pipeline",
+             "CATALOG_PARAMS": "catalog"}.get(name)
     if table is None or not isinstance(node.value, ast.Dict):
         return
     for k in node.value.keys:
@@ -802,7 +803,7 @@ class ContractEngine:
                 facts.families, key=lambda t: (t[0], t[1], t[3])):
             families.setdefault(fam, label)
         params: Dict[str, List[str]] = {"serve": [], "fleet": [],
-                                        "pipeline": []}
+                                        "pipeline": [], "catalog": []}
         for _, table, key, _ in facts.params:
             if key not in params[table]:
                 params[table].append(key)

@@ -1,0 +1,34 @@
+"""xgboost_tpu.catalog — the multi-tenant model catalog (CATALOG.md
+section of SERVING.md).
+
+The fleet and pipeline historically spoke exactly ONE model; production
+serves many.  This package holds the pieces that multiplex N named
+models over the same replica set without giving up any single-model
+guarantee:
+
+- :class:`ModelCatalog` — N named models per replica, each an
+  independent :class:`~xgboost_tpu.serving.registry.ModelRegistry`
+  (own AOT bucket set, own hot-reload poll, own optional feature
+  store), admitted under ONE shared device-memory budget with
+  LRU-evict + hysteresis for cold models' engines;
+- :class:`TenantQuotas` — per-model admission control at the router
+  (in-flight cap -> 503, token-bucket rate limit -> 429), so one
+  tenant's overload never touches its neighbors;
+- :func:`parse_manifest` — the ``catalog=`` knob's ``name=path``
+  manifest format (inline comma-separated or a file).
+
+Per-tenant TRAINING lanes need no new machinery: one
+:class:`~xgboost_tpu.pipeline.ContinuousTrainer` per tenant, each with
+its own workdir + publish path (``xgboost_tpu.pipeline.
+run_tenant_lanes``), gives every tenant its own fsync'd gated-hash
+ledger — the "zero ungated models served" chaos contract holds per
+tenant by construction (tools/chaos_loop.py --catalog proves it).
+"""
+
+from xgboost_tpu.catalog.catalog import (CatalogEntry,  # noqa: F401
+                                         ModelCatalog, UnknownModel,
+                                         parse_manifest)
+from xgboost_tpu.catalog.quota import TenantQuotas  # noqa: F401
+
+__all__ = ["ModelCatalog", "CatalogEntry", "UnknownModel",
+           "parse_manifest", "TenantQuotas"]

@@ -31,8 +31,9 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
-from xgboost_tpu.config import (FLEET_PARAMS, PIPELINE_PARAMS,
-                                SERVE_PARAMS, parse_config_file)
+from xgboost_tpu.config import (CATALOG_PARAMS, FLEET_PARAMS,
+                                PIPELINE_PARAMS, SERVE_PARAMS,
+                                parse_config_file)
 
 # process start, for recovery-cost accounting.  perf_counter, not
 # wall-clock: these readings are only ever subtracted (XGT006)
@@ -74,6 +75,9 @@ task=fleet_router parameters:
 
 task=pipeline parameters:
 {pipeline_params}
+
+catalog parameters (multi-tenant serving, task=serve + task=fleet_router):
+{catalog_params}
 """
 
 
@@ -116,6 +120,8 @@ class BoostLearnTask:
         self.fleet_params = {k: v for k, (v, _) in FLEET_PARAMS.items()}
         self.pipeline_params = {k: v
                                 for k, (v, _) in PIPELINE_PARAMS.items()}
+        self.catalog_params = {k: v
+                               for k, (v, _) in CATALOG_PARAMS.items()}
 
     # ------------------------------------------------------------- params
     _OWN = {
@@ -192,6 +198,8 @@ class BoostLearnTask:
             self.fleet_params[name] = type(FLEET_PARAMS[name][0])(val)
         elif name in self.pipeline_params:
             self.pipeline_params[name] = type(PIPELINE_PARAMS[name][0])(val)
+        elif name in self.catalog_params:
+            self.catalog_params[name] = type(CATALOG_PARAMS[name][0])(val)
         else:
             m = re.match(r"eval\[([^\]]+)\]", name)
             if m:
@@ -205,12 +213,14 @@ class BoostLearnTask:
     # --------------------------------------------------------------- run
     def run(self, argv: List[str]) -> int:
         if not argv:
-            from xgboost_tpu.config import (fleet_params_help,
+            from xgboost_tpu.config import (catalog_params_help,
+                                            fleet_params_help,
                                             pipeline_params_help,
                                             serve_params_help)
             print(_USAGE.format(serve_params=serve_params_help(),
                                 fleet_params=fleet_params_help(),
-                                pipeline_params=pipeline_params_help()))
+                                pipeline_params=pipeline_params_help(),
+                                catalog_params=catalog_params_help()))
             return 0
         if os.path.exists(argv[0]) or "=" not in argv[0]:
             for name, val in parse_config_file(argv[0]):
@@ -563,13 +573,18 @@ class BoostLearnTask:
     # -------------------------------------------------------------- serve
     def task_serve(self) -> int:
         """Run the HTTP prediction service on model_in (the serving
-        subsystem; quickstart in README 'Serving', design in SERVING.md).
+        subsystem; quickstart in README 'Serving', design in SERVING.md)
+        — or on a multi-model catalog manifest (catalog=...,
+        xgboost_tpu.catalog), where bare /predict serves the default
+        model and ?model=NAME picks a tenant.
         """
-        assert self.model_in, "model_in not specified"
-        from xgboost_tpu.serving import run_server
         sp = self.serve_params
+        cp = self.catalog_params
+        assert self.model_in or cp["catalog"], \
+            "model_in not specified (or pass catalog=name=path,...)"
+        from xgboost_tpu.serving import run_server
         run_server(
-            self.model_in,
+            self.model_in or "",
             host=sp["serve_host"], port=sp["serve_port"],
             min_bucket=sp["serve_min_bucket"],
             max_bucket=sp["serve_max_bucket"],
@@ -582,6 +597,10 @@ class BoostLearnTask:
             drain_sec=sp["serve_drain_sec"],
             max_body_mb=sp["serve_max_body_mb"],
             featurestore_mb=sp["serve_featurestore_mb"],
+            catalog=cp["catalog"],
+            catalog_default=cp["catalog_default"],
+            catalog_mb=cp["serve_catalog_mb"],
+            catalog_hysteresis_sec=cp["catalog_hysteresis_sec"],
             router_url=sp["serve_router_url"],
             replica_id=sp["serve_replica_id"],
             advertise_url=sp["serve_advertise_url"],
@@ -595,6 +614,7 @@ class BoostLearnTask:
         ``task=serve serve_router_url=http://host:port``."""
         from xgboost_tpu.fleet import run_router
         fp = self.fleet_params
+        cp = self.catalog_params
         run_router(
             host=fp["fleet_host"], port=fp["fleet_port"],
             lease_sec=fp["fleet_lease_sec"], hc_sec=fp["fleet_hc_sec"],
@@ -607,6 +627,10 @@ class BoostLearnTask:
             deadline_ms=fp["fleet_deadline_ms"],
             slow_eject_factor=fp["fleet_slow_eject_factor"],
             slow_eject_cooldown_sec=fp["fleet_slow_eject_cooldown_sec"],
+            state_path=fp["fleet_state_path"],
+            tenant_inflight=cp["tenant_inflight"],
+            tenant_rate=cp["tenant_rate"],
+            tenant_burst=cp["tenant_burst"],
             rollout_defaults={
                 "canaries": fp["fleet_canaries"],
                 "soak_sec": fp["fleet_soak_sec"],

@@ -365,6 +365,12 @@ FLEET_PARAMS: Dict[str, Tuple[Any, str]] = {
                                     "soak"),
     "fleet_gate_p99_ms": (250.0, "rollout gate: max canary p99 request "
                                  "latency in milliseconds"),
+    "fleet_state_path": ("", "membership snapshot file (CRC-footered, "
+                             "atomically rewritten on membership "
+                             "changes and each health pass): a "
+                             "restarted router restores its replica "
+                             "set from here instead of waiting for "
+                             "heartbeats (empty = stateless restart)"),
 }
 
 
@@ -425,6 +431,50 @@ def pipeline_params_help() -> str:
     """One line per task=pipeline parameter, for CLI usage text."""
     return "\n".join(f"  {name:<26} {help_} (default {default!r})"
                      for name, (default, help_) in PIPELINE_PARAMS.items())
+
+
+# -------------------------------------------------------------- catalog
+# Multi-tenant model catalog (xgboost_tpu.catalog, SERVING.md): knobs
+# shared by task=serve (the replica-side catalog) and task=fleet_router
+# (per-tenant quotas).  Same single-table discipline as SERVE_PARAMS:
+# one row here is the whole public surface for a knob, XGT010 enforces
+# that every key is consumed outside config.py, and the inventory rides
+# ANALYSIS_CONTRACTS.json.
+CATALOG_PARAMS: Dict[str, Tuple[Any, str]] = {
+    "catalog": ("", "model catalog manifest: inline "
+                    "'name=path,name=path' pairs, or a path to a "
+                    "'name = path' config file (one model per line). "
+                    "Empty = single-model serving (a catalog of one)"),
+    "catalog_default": ("", "model served by bare /predict (no "
+                            "?model=); default: the model= file when "
+                            "given, else the manifest's first entry"),
+    "serve_catalog_mb": (0.0, "shared device byte budget across ALL "
+                              "resident catalog models (engines + "
+                              "per-model feature stores); past it the "
+                              "coldest non-default models are evicted "
+                              "(0 = unlimited, everything stays "
+                              "resident)"),
+    "catalog_hysteresis_sec": (3.0, "minimum residency before a model "
+                                    "becomes evictable — bounds "
+                                    "admit/evict thrash when the "
+                                    "working set exceeds the budget"),
+    "tenant_inflight": (0, "router: per-tenant in-flight request "
+                           "budget; a tenant past it sheds 503 without "
+                           "touching its neighbors (0 = no per-tenant "
+                           "cap)"),
+    "tenant_rate": (0.0, "router: per-tenant sustained request rate "
+                         "limit in req/s (token bucket; over-rate "
+                         "requests shed 429; 0 = unlimited)"),
+    "tenant_burst": (8.0, "router: token-bucket burst size — requests "
+                          "a tenant may send back-to-back before "
+                          "tenant_rate applies"),
+}
+
+
+def catalog_params_help() -> str:
+    """One line per catalog parameter, for CLI usage text."""
+    return "\n".join(f"  {name:<26} {help_} (default {default!r})"
+                     for name, (default, help_) in CATALOG_PARAMS.items())
 
 
 def parse_config_file(path: str) -> List[Tuple[str, str]]:

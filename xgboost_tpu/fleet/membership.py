@@ -53,12 +53,17 @@ class Replica:
     def __init__(self, replica_id: str, url: str,
                  model_path: Optional[str] = None,
                  model_hash: Optional[str] = None,
-                 pid: Optional[int] = None):
+                 pid: Optional[int] = None,
+                 models: Optional[Dict[str, dict]] = None):
         self.replica_id = replica_id
         self.url = url.rstrip("/")
         self.model_path = model_path
         self.model_hash = model_hash
         self.pid = pid
+        # catalog advertisement: {model_name: {"path":..., "hash":...}}
+        # — which named models this replica can serve (empty = a
+        # pre-catalog replica that only answers bare /predict)
+        self.models: Dict[str, dict] = dict(models or {})
         self.lease_deadline = 0.0       # monotonic
         self.registered_count = 0       # bumps on every (re-)register
         self.health_ok = True           # last /healthz verdict
@@ -92,6 +97,7 @@ class Replica:
             "url": self.url,
             "model_path": self.model_path,
             "model_hash": self.model_hash,
+            "models": sorted(self.models),
             "pid": self.pid,
             "lease_remaining_sec": round(self.lease_deadline - now, 3),
             "health_ok": self.health_ok,
@@ -190,7 +196,8 @@ class Membership:
     def register(self, replica_id: str, url: str,
                  model_path: Optional[str] = None,
                  model_hash: Optional[str] = None,
-                 pid: Optional[int] = None) -> dict:
+                 pid: Optional[int] = None,
+                 models: Optional[Dict[str, dict]] = None) -> dict:
         """Add (or revive — the tracker ``recover`` path) a replica and
         grant a heartbeat lease.  Returns the lease grant."""
         from xgboost_tpu.obs import event
@@ -200,7 +207,8 @@ class Membership:
             rep = self._replicas.get(replica_id)
             recovered = rep is not None
             if rep is None:
-                rep = Replica(replica_id, url, model_path, model_hash, pid)
+                rep = Replica(replica_id, url, model_path, model_hash, pid,
+                              models=models)
                 self._replicas[replica_id] = rep
             else:
                 # a restarted process re-registers under its old id:
@@ -213,6 +221,8 @@ class Membership:
                 rep.model_path = model_path or rep.model_path
                 rep.model_hash = model_hash or rep.model_hash
                 rep.pid = pid if pid is not None else rep.pid
+                if models is not None:
+                    rep.models = dict(models)
                 rep.breaker = BREAKER_CLOSED
                 rep.consecutive_failures = 0
                 rep.probe_inflight = False
@@ -238,9 +248,13 @@ class Membership:
         return {"lease_sec": self.lease_sec, "recovered": recovered}
 
     def heartbeat(self, replica_id: str,
-                  model_hash: Optional[str] = None) -> bool:
+                  model_hash: Optional[str] = None,
+                  models: Optional[Dict[str, dict]] = None) -> bool:
         """Renew a lease.  False = unknown replica (the client should
-        re-register — its lease expired or the router restarted)."""
+        re-register — its lease expired or the router restarted).
+        ``models`` keeps the catalog advertisement fresh — a rollout
+        that bumps one tenant's hash shows up here within a lease
+        period."""
         now = time.monotonic()
         with self._lock:
             rep = self._replicas.get(replica_id)
@@ -249,6 +263,8 @@ class Membership:
             rep.lease_deadline = now + self.lease_sec
             if model_hash:
                 rep.model_hash = model_hash
+            if models is not None:
+                rep.models = dict(models)
             return True
 
     def deregister(self, replica_id: str) -> bool:
@@ -289,6 +305,27 @@ class Membership:
                     if r.lease_live(now) and r.health_ok
                     and r.health_state == "serving"]
 
+    def hosting(self, model: str) -> set:
+        """Replica ids advertising ``model`` in their catalog.  Empty
+        model = no filter (every replica hosts its own bare default).
+        A pre-catalog replica (empty advertisement) hosts no NAMED
+        model — routing one there would bounce off its 404."""
+        with self._lock:
+            if not model:
+                return set(self._replicas)
+            return {rid for rid, r in self._replicas.items()
+                    if model in r.models}
+
+    def models_hosted(self) -> Dict[str, int]:
+        """model name -> number of replicas advertising it (the
+        router's /fleet/members summary)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for r in self._replicas.values():
+                for m in r.models:
+                    out[m] = out.get(m, 0) + 1
+        return out
+
     def describe(self) -> dict:
         now = time.monotonic()
         with self._lock:
@@ -299,6 +336,42 @@ class Membership:
         return {"replicas": sorted(reps, key=lambda d: d["replica_id"]),
                 "in_rotation": len(rotation),
                 "registered": len(reps)}
+
+    # ----------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        """Serializable membership state for the router's zero-downtime
+        restart (``fleet_state_path``): identity + endpoint + catalog
+        advertisement of every LEASE-LIVE replica.  Transient state
+        (breaker, EWMA, outstanding) is deliberately dropped — a
+        restarted router re-learns it in seconds, while a stale 'open'
+        breaker would wrongly blackhole a recovered replica."""
+        now = time.monotonic()
+        with self._lock:
+            return {"replicas": [
+                {"replica_id": r.replica_id, "url": r.url,
+                 "model_path": r.model_path, "model_hash": r.model_hash,
+                 "pid": r.pid, "models": r.models}
+                for r in self._replicas.values() if r.lease_live(now)]}
+
+    def restore(self, state: dict) -> int:
+        """Re-register every snapshotted replica with a FRESH lease:
+        restored members take traffic immediately (zero-downtime
+        restart), and any that died while the router was down fall out
+        on the first health pass / lease expiry — exactly how a crashed
+        replica is handled in steady state."""
+        n = 0
+        for d in state.get("replicas", []):
+            try:
+                self.register(d["replica_id"], d["url"],
+                              model_path=d.get("model_path"),
+                              model_hash=d.get("model_hash"),
+                              pid=d.get("pid"),
+                              models=d.get("models"))
+                n += 1
+            except (KeyError, TypeError) as e:
+                from xgboost_tpu.obs.metrics import swallowed_error
+                swallowed_error("fleet.membership.restore", e)
+        return n
 
     # ---------------------------------------------------------- dispatch
     def _breaker_allows_locked(self, rep: Replica, now: float) -> bool:
@@ -345,15 +418,19 @@ class Membership:
                 r.eject_probe_inflight = False
                 r.eject_probe_tid = 0
 
-    def acquire(self, exclude=()) -> Optional[Replica]:
+    def acquire(self, exclude=(), model: str = "") -> Optional[Replica]:
         """Pick the LEAST-LOADED dispatch target (fewest outstanding
         requests) over in-rotation, breaker- and ejection-permitting
         replicas and count it as outstanding.  ``exclude`` removes
-        replicas already tried (the retry path).  Entity-id traffic
-        uses :meth:`acquire_specific` on the resolved ring owner
-        instead.  Callers MUST pair with :meth:`release`."""
+        replicas already tried (the retry path); ``model`` restricts
+        the pool to replicas HOSTING that catalog model (least-loaded
+        within the hosting set — model-aware routing).  Entity-id
+        traffic uses :meth:`acquire_specific` on the resolved ring
+        owner instead.  Callers MUST pair with :meth:`release`."""
         now = time.monotonic()
         rotation = {r.replica_id for r in self.in_rotation()}
+        if model:
+            rotation &= self.hosting(model)
         with self._lock:
             candidates = [r for rid, r in self._replicas.items()
                           if rid in rotation and rid not in exclude]
@@ -405,16 +482,21 @@ class Membership:
             rep.outstanding += 1
             return rep
 
-    def route_ids(self, ids: List) -> Dict[str, List[int]]:
+    def route_ids(self, ids: List, model: str = "") -> Dict[str, List[int]]:
         """Partition entity ids by their consistent-hash owner among
         in-rotation replicas: ``{replica_id: [positions...]}`` in input
-        order.  Empty when no replica is available.
+        order.  Empty when no replica is available.  ``model`` keys
+        ownership per (model, entity): the hash input is prefixed with
+        the model name AND the eligible set shrinks to its hosting
+        replicas, so each tenant's hot rows concentrate independently.
 
         Only the ring FRESHNESS check holds the membership lock; the
         per-id hashing runs outside it (the ring's node arrays swap
         atomically on rebuild), so a large id list cannot stall every
         concurrent dispatch/heartbeat behind SHA-1 work."""
         eligible = {r.replica_id for r in self.in_rotation()}
+        if model:
+            eligible &= self.hosting(model)
         out: Dict[str, List[int]] = {}
         if not eligible:
             return out
@@ -423,8 +505,9 @@ class Membership:
                 self._ring.rebuild(sorted(self._replicas))
                 self._ring_stale = False
             ring = self._ring
+        prefix = f"{model}\x00" if model else ""
         for i, eid in enumerate(ids):
-            rid = ring.route(str(eid), eligible)
+            rid = ring.route(prefix + str(eid), eligible)
             if rid is not None:
                 out.setdefault(rid, []).append(i)
         return out
@@ -612,12 +695,16 @@ class LeaseClient:
     def __init__(self, router_url: str, replica_id: str, self_url: str,
                  model_path: Optional[str] = None,
                  model_hash_fn: Optional[Callable[[], Optional[str]]] = None,
+                 models_fn: Optional[Callable[[], dict]] = None,
                  on_kill: Optional[Callable[[], None]] = None):
         self.router_url = router_url.rstrip("/")
         self.replica_id = replica_id
         self.self_url = self_url.rstrip("/")
         self.model_path = model_path
         self.model_hash_fn = model_hash_fn or (lambda: None)
+        # catalog advertisement: () -> {name: {"path":..., "hash":...}}
+        # carried on register AND every heartbeat (rollouts move hashes)
+        self.models_fn = models_fn or (lambda: None)
         self.on_kill = on_kill or (lambda: os._exit(43))
         self.lease_sec = 10.0
         self.registered = False
@@ -643,6 +730,7 @@ class LeaseClient:
                 "url": self.self_url,
                 "model_path": self.model_path,
                 "model_hash": self.model_hash_fn(),
+                "models": self.models_fn(),
                 "pid": os.getpid(),
             })
             self.lease_sec = float(grant.get("lease_sec", self.lease_sec))
@@ -668,7 +756,8 @@ class LeaseClient:
         try:
             resp = self._post("/fleet/heartbeat",
                               {"replica_id": self.replica_id,
-                               "model_hash": self.model_hash_fn()})
+                               "model_hash": self.model_hash_fn(),
+                               "models": self.models_fn()})
             self.heartbeats_sent += 1
             if not resp.get("known", True):
                 # the router forgot us (restart / expired lease):

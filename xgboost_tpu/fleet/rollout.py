@@ -95,9 +95,29 @@ class RolloutController:
         except ValueError:
             return None
 
-    def _served_hash(self, rep: Replica) -> Optional[str]:
+    @staticmethod
+    def _model_path(rep: Replica, model: str = "") -> Optional[str]:
+        """The file to rewrite on ``rep`` for this rollout: its bare
+        registered path, or — for a named tenant — the path its catalog
+        advertisement maps the model to."""
+        if not model:
+            return rep.model_path
+        return (rep.models.get(model) or {}).get("path")
+
+    @staticmethod
+    def _admin_path(path: str, model: str = "") -> str:
+        return f"{path}?model={model}" if model else path
+
+    def _served_hash(self, rep: Replica, model: str = "") -> Optional[str]:
+        """What the replica ACTUALLY serves: the top-level hash, or —
+        for a named tenant — its row in /healthz ``models`` (per-model
+        content hashes, serving/http.py)."""
         h = self._call(rep, "GET", "/healthz")
-        return h.get("model_hash") if h else None
+        if h is None:
+            return None
+        if not model:
+            return h.get("model_hash")
+        return (h.get("models", {}).get(model) or {}).get("model_hash")
 
     def _metrics_snapshot(self, rep: Replica) -> Optional[Dict[str, float]]:
         try:
@@ -111,39 +131,52 @@ class RolloutController:
         return scrape_samples(out.decode("utf-8", "replace"))
 
     # ---------------------------------------------------------------- push
-    def _push(self, rep: Replica, raw: bytes, expect_hash: str) -> dict:
-        """Write + force-reload + verify one replica.  Returns a
+    def _push(self, rep: Replica, raw: bytes, expect_hash: str,
+              model: str = "") -> dict:
+        """Write + force-reload + verify one replica (one tenant's
+        path/reload/hash when ``model`` names one).  Returns a
         per-replica report entry."""
         from xgboost_tpu.reliability.integrity import atomic_write
-        entry = {"replica_id": rep.replica_id, "path": rep.model_path}
-        if not rep.model_path:
-            entry["result"] = "no model_path registered"
+        path = self._model_path(rep, model)
+        entry = {"replica_id": rep.replica_id, "path": path}
+        if model:
+            entry["model"] = model
+        if not path:
+            entry["result"] = (f"model {model!r} not hosted" if model
+                               else "no model_path registered")
             return entry
         try:
-            atomic_write(rep.model_path, raw)
+            atomic_write(path, raw)
         except OSError as e:
             entry["result"] = f"write failed: {e}"
             return entry
-        resp = self._call(rep, "POST", "/-/reload")
+        resp = self._call(rep, "POST",
+                          self._admin_path("/-/reload", model))
         if resp is None:
             entry["result"] = "reload unreachable"
             return entry
-        got = self._served_hash(rep)
+        got = self._served_hash(rep, model)
         entry["served_hash"] = got
         entry["result"] = ("ok" if got == expect_hash
                            else f"hash mismatch (serves {got})")
         return entry
 
-    def _unpush(self, rep: Replica) -> dict:
-        """Instant engine rollback + file restore for one replica."""
+    def _unpush(self, rep: Replica, model: str = "") -> dict:
+        """Instant engine rollback + file restore for one replica
+        (scoped to one tenant's registry when ``model`` names one —
+        the other tenants' engines and files are untouched)."""
         from xgboost_tpu.reliability.integrity import atomic_write
         entry = {"replica_id": rep.replica_id}
-        resp = self._call(rep, "POST", "/-/rollback")
+        if model:
+            entry["model"] = model
+        resp = self._call(rep, "POST",
+                          self._admin_path("/-/rollback", model))
         entry["engine_rollback"] = bool(resp and resp.get("rolled_back"))
-        backup = self.state.get(rep.model_path)
+        path = self._model_path(rep, model)
+        backup = self.state.get(path)
         if backup is not None:
             try:
-                atomic_write(rep.model_path, backup)
+                atomic_write(path, backup)
                 entry["file_restored"] = True
             except OSError as e:
                 entry["file_restored"] = f"failed: {e}"
@@ -192,14 +225,20 @@ class RolloutController:
     # -------------------------------------------------------------- public
     def rollout(self, model_path: str, canaries: int = 1,
                 soak_sec: float = 3.0, gate_error_rate: float = 0.02,
-                gate_p99_ms: float = 250.0) -> dict:
+                gate_p99_ms: float = 250.0, model: str = "") -> dict:
         """One staged rollout of the model file at ``model_path``.
 
         Stages: verify bytes -> push to ``canaries`` path-groups ->
         soak ``soak_sec`` under whatever traffic the router is carrying
         -> gate on the canaries' own error-rate/latency metrics ->
         fleet-wide push, or rollback of the canaries.  Returns a full
-        report (also kept on ``GET /fleet/rollout``)."""
+        report (also kept on ``GET /fleet/rollout``).
+
+        When ``model`` names a catalog tenant the rollout is scoped to
+        that tenant's lane: only replicas advertising the model are
+        touched, each replica's file target is its OWN advertised path
+        for that model, and push/gate/rollback leave every other
+        tenant's engines, files, and backups untouched."""
         from xgboost_tpu.reliability.integrity import (read_file,
                                                        verify_model_bytes)
         raw = read_file(model_path)
@@ -207,8 +246,18 @@ class RolloutController:
         expect = hashlib.sha256(raw).hexdigest()
         report: dict = {"model_path": model_path, "model_hash": expect,
                         "started_ts": round(time.time(), 3)}
+        if model:
+            report["model"] = model
         members = sorted(self.membership.in_rotation(),
                          key=lambda r: r.replica_id)
+        if model:
+            members = [r for r in members
+                       if self._model_path(r, model)]
+            if not members:
+                report.update(status="error",
+                              error=f"no replica in rotation hosts "
+                                    f"model {model!r}")
+                return report
         if not members:
             report.update(status="error", error="no replicas in rotation")
             return report
@@ -218,14 +267,15 @@ class RolloutController:
         canary_set: List[Replica] = []
         canary_paths = set()
         for rep in members:
-            if len(canary_set) < canaries or rep.model_path in canary_paths:
+            path = self._model_path(rep, model)
+            if len(canary_set) < canaries or path in canary_paths:
                 canary_set.append(rep)
-                canary_paths.add(rep.model_path)
+                canary_paths.add(path)
         rest = [r for r in members if r not in canary_set
-                and r.model_path not in canary_paths]
+                and self._model_path(r, model) not in canary_paths]
         report["canaries"] = [r.replica_id for r in canary_set]
         event("fleet.rollout_start", model_hash=expect,
-              canaries=report["canaries"])
+              canaries=report["canaries"], model=model or None)
 
         # refresh the rollback backups for THIS rollout, before any
         # file is touched: a backup taken only on first-ever push would
@@ -233,7 +283,9 @@ class RolloutController:
         # would restore the pre-FIRST-rollout bytes — the engine ring
         # pops to version N-1 while the file (and the poller) goes to
         # N-2, silently splitting the fleet
-        for path in {r.model_path for r in members if r.model_path}:
+        for path in {self._model_path(r, model) for r in members}:
+            if not path:
+                continue
             try:
                 self.state[path] = read_file(path)
             except OSError as e:
@@ -243,7 +295,8 @@ class RolloutController:
 
         before = {r.replica_id: self._metrics_snapshot(r)
                   for r in canary_set}
-        pushes = [self._push(r, raw, expect) for r in canary_set]
+        pushes = [self._push(r, raw, expect, model=model)
+                  for r in canary_set]
         report["canary_push"] = pushes
         failed_push = [p for p in pushes if p.get("result") != "ok"]
         if not failed_push and soak_sec > 0:
@@ -254,31 +307,42 @@ class RolloutController:
                      for r in canary_set])
         report["canary_gate"] = verdicts
         if failed_push or not all(v["pass"] for v in verdicts):
-            report["rollback"] = [self._unpush(r) for r in canary_set]
+            report["rollback"] = [self._unpush(r, model=model)
+                                  for r in canary_set]
             report["status"] = "rolled_back"
             report["reason"] = (failed_push[0]["result"] if failed_push
                                 else next(v["reason"] for v in verdicts
                                           if not v["pass"]))
             fleet_metrics().rollbacks.inc()
             event("fleet.rollout_rolled_back", model_hash=expect,
-                  reason=report["reason"])
+                  reason=report["reason"], model=model or None)
             return report
 
-        report["fleet_push"] = [self._push(r, raw, expect) for r in rest]
+        report["fleet_push"] = [self._push(r, raw, expect, model=model)
+                                for r in rest]
         bad = [p for p in report["fleet_push"] if p.get("result") != "ok"]
         report["status"] = "ok" if not bad else "partial"
         report["serving_hash"] = expect
         fleet_metrics().rollouts.inc()
         event("fleet.rollout_done", model_hash=expect,
-              status=report["status"])
+              status=report["status"], model=model or None)
         return report
 
-    def rollback(self) -> dict:
+    def rollback(self, model: str = "") -> dict:
         """The one-command fleet rollback: every registered replica
         swaps its previous engine back in (instant, no disk) and any
-        file this controller's state pushed is restored."""
+        file this controller's state pushed is restored.  With
+        ``model`` the sweep is scoped to replicas hosting that tenant
+        and only its registry/file are rolled back."""
         reps = [self.membership.get(rid) for rid in self.membership.ids()]
-        entries = [self._unpush(r) for r in reps if r is not None]
+        if model:
+            reps = [r for r in reps
+                    if r is not None and self._model_path(r, model)]
+        entries = [self._unpush(r, model=model)
+                   for r in reps if r is not None]
         fleet_metrics().rollbacks.inc()
-        event("fleet.rollback", replicas=len(entries))
-        return {"status": "rolled_back", "replicas": entries}
+        event("fleet.rollback", replicas=len(entries), model=model or None)
+        out = {"status": "rolled_back", "replicas": entries}
+        if model:
+            out["model"] = model
+        return out

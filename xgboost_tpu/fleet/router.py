@@ -41,13 +41,14 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import signal
 import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from xgboost_tpu.obs import span, trace, trace_context
 from xgboost_tpu.obs.metrics import fleet_metrics
@@ -203,7 +204,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._fleet_rollout(body)
             return
         if url.path == "/fleet/rollback":
-            self._fleet_rollback()
+            self._fleet_rollback(body)
             return
         self._send_json(404, {"error": f"no route {url.path}"})
 
@@ -217,7 +218,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return
         grant = self.server.router.membership.register(
             rid, rurl, model_path=req.get("model_path"),
-            model_hash=req.get("model_hash"), pid=req.get("pid"))
+            model_hash=req.get("model_hash"), pid=req.get("pid"),
+            models=req.get("models"))
+        self.server.router.save_state()
         self._send_json(200, grant)
 
     def _fleet_heartbeat(self, body: bytes) -> None:
@@ -228,7 +231,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad request: {e}"})
             return
         known = self.server.router.membership.heartbeat(
-            rid, model_hash=req.get("model_hash"))
+            rid, model_hash=req.get("model_hash"),
+            models=req.get("models"))
         # 200 either way: "known": false tells the client to re-register
         # (the tracker recover path) without an error-path round trip
         self._send_json(200, {"known": known})
@@ -240,8 +244,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError) as e:
             self._send_json(400, {"error": f"bad request: {e}"})
             return
-        self._send_json(200, {
-            "removed": self.server.router.membership.deregister(rid)})
+        removed = self.server.router.membership.deregister(rid)
+        self.server.router.save_state()
+        self._send_json(200, {"removed": removed})
 
     # ------------------------------------------------------------- rollout
     def _fleet_rollout(self, body: bytes) -> None:
@@ -254,32 +259,49 @@ class _RouterHandler(BaseHTTPRequestHandler):
         code, report = self.server.router.run_rollout(model_path, req)
         self._send_json(code, report)
 
-    def _fleet_rollback(self) -> None:
-        code, report = self.server.router.run_rollback()
+    def _fleet_rollback(self, body: bytes) -> None:
+        try:
+            req = json.loads(body) if body.strip() else {}
+        except ValueError as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        code, report = self.server.router.run_rollback(
+            model=str(req.get("model", "")))
         self._send_json(code, report)
 
     # ------------------------------------------------------------ proxying
     def _proxy_predict(self, url, body: bytes) -> None:
         rt: FleetRouter = self.server.router
         self._proxy(url, body,
-                    lambda path_qs, hdrs, sp, dl: rt.dispatch(
-                        "POST", path_qs, body, hdrs, sp, deadline=dl))
+                    lambda path_qs, hdrs, sp, dl, model: rt.dispatch(
+                        "POST", path_qs, body, hdrs, sp, deadline=dl,
+                        model=model))
 
     def _proxy_by_id(self, url, body: bytes) -> None:
         rt: FleetRouter = self.server.router
         self._proxy(url, body,
-                    lambda path_qs, hdrs, sp, dl: rt.dispatch_by_id(
-                        url.path, path_qs, body, hdrs, sp, deadline=dl))
+                    lambda path_qs, hdrs, sp, dl, model: rt.dispatch_by_id(
+                        url.path, path_qs, body, hdrs, sp, deadline=dl,
+                        model=model))
 
     def _proxy(self, url, body: bytes, dispatch_fn) -> None:
         """THE proxy shell shared by every forwarded route: admission
-        (budget shed -> 503, expired deadline -> 504), the
-        router.request span under the client's trace id, and the error
-        mapping (NoReplica -> 503, ForwardError -> 502, spent deadline
-        -> 504, bad by-id payload -> 400)."""
+        (per-tenant quota shed -> 429/503, budget shed -> 503, expired
+        deadline -> 504), the router.request span under the client's
+        trace id, and the error mapping (NoReplica -> 503, ForwardError
+        -> 502, spent deadline -> 504, bad by-id payload -> 400).
+
+        ``?model=`` names the tenant: requests route only to replicas
+        HOSTING that catalog model, and the per-tenant quota + the
+        labeled ``xgbtpu_tenant_*`` metrics key on it — one tenant's
+        overload sheds as ITS 429/503s while its neighbors' traffic
+        flows untouched."""
         rid = self.headers.get("X-Request-Id") or trace.new_id()
         self._request_id = rid
         rt: FleetRouter = self.server.router
+        model = (parse_qs(url.query).get("model", [""])[0]
+                 if url.query else "")
+        tenant = model or "default"
         # the request's end-to-end budget: the client's X-Deadline-Ms,
         # or the router's fleet_deadline_ms default when configured —
         # every downstream hop SPENDS from this one object
@@ -294,34 +316,73 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                            "dispatch",
                                   "deadline_exceeded": True})
             return
-        if not rt.enter_request():
-            fleet_metrics().shed.inc()
-            self.close_connection = True
-            self._send_json(503, {"error": "router overloaded "
-                                           "(in-flight budget)",
-                                  "shed": True})
-            return
+        from xgboost_tpu.obs.metrics import tenant_metrics
+        tm = tenant_metrics()
+        tm.requests.inc(tenant)
+        if model and not rt.membership.hosting(model):
+            # no replica advertises this model: 404 (a client error)
+            # when the fleet is otherwise alive, 503 when it is empty
+            # (nothing can answer ANY model — same as NoReplica)
+            if rt.membership.ids():
+                tm.shed.inc(tenant)
+                self._send_json(404, {
+                    "error": f"no replica hosts model {model!r}",
+                    "models": sorted(rt.membership.models_hosted())})
+                return
+        if rt.quotas.enabled:
+            why = rt.quotas.try_admit(tenant)
+            if why is not None:
+                # rate -> 429 (slow down), inflight -> 503 (shed now):
+                # the tenant's OWN budget said no — no global slot, no
+                # replica work, no neighbor touched
+                tm.shed.inc(tenant)
+                self.close_connection = True
+                if why == "rate":
+                    self._send_json(429, {
+                        "error": f"tenant {tenant!r} over rate limit",
+                        "shed": True, "model": tenant})
+                else:
+                    self._send_json(503, {
+                        "error": f"tenant {tenant!r} over in-flight "
+                                 "budget", "shed": True, "model": tenant})
+                return
+            tm.inflight.set(tenant, rt.quotas.inflight(tenant))
         try:
-            with trace_context(rid):
-                with span("router.request", request_id=rid,
-                          path=url.path) as sp:
-                    status, headers, out = dispatch_fn(
-                        _path_qs(url), self._fwd_headers(rid, dl), sp,
-                        dl)
-            self._relay(status, headers, out)
-        except NoReplica:
-            self._send_json(503, {"error": "no replica available"})
-        except DeadlineExceeded as e:
-            from xgboost_tpu.profiling import reliability_metrics
-            reliability_metrics().deadline_rejected.inc()
-            self._send_json(504, {"error": str(e),
-                                  "deadline_exceeded": True})
-        except ForwardError as e:
-            self._send_json(502, {"error": str(e)})
-        except ValueError as e:
-            self._send_json(400, {"error": f"bad request: {e}"})
+            if not rt.enter_request():
+                fleet_metrics().shed.inc()
+                self.close_connection = True
+                self._send_json(503, {"error": "router overloaded "
+                                               "(in-flight budget)",
+                                      "shed": True})
+                return
+            t_req = time.perf_counter()
+            try:
+                with trace_context(rid):
+                    with span("router.request", request_id=rid,
+                              path=url.path, model=model or None) as sp:
+                        status, headers, out = dispatch_fn(
+                            _path_qs(url), self._fwd_headers(rid, dl), sp,
+                            dl, model)
+                tm.latency_ms.inc(
+                    tenant, (time.perf_counter() - t_req) * 1e3)
+                self._relay(status, headers, out)
+            except NoReplica:
+                self._send_json(503, {"error": "no replica available"})
+            except DeadlineExceeded as e:
+                from xgboost_tpu.profiling import reliability_metrics
+                reliability_metrics().deadline_rejected.inc()
+                self._send_json(504, {"error": str(e),
+                                      "deadline_exceeded": True})
+            except ForwardError as e:
+                self._send_json(502, {"error": str(e)})
+            except ValueError as e:
+                self._send_json(400, {"error": f"bad request: {e}"})
+            finally:
+                rt.exit_request()
         finally:
-            rt.exit_request()
+            if rt.quotas.enabled:
+                rt.quotas.release(tenant)
+                tm.inflight.set(tenant, rt.quotas.inflight(tenant))
 
     def _fwd_headers(self, rid: str, dl=None) -> Dict[str, str]:
         h = {"X-Request-Id": rid}
@@ -374,12 +435,25 @@ class FleetRouter:
                  slow_eject_factor: float = 3.0,
                  slow_eject_cooldown_sec: float = 5.0,
                  rollout_defaults: Optional[dict] = None,
+                 state_path: str = "",
+                 tenant_inflight: int = 0,
+                 tenant_rate: float = 0.0,
+                 tenant_burst: float = 8.0,
                  quiet: bool = True):
+        from xgboost_tpu.catalog import TenantQuotas
         self.membership = Membership(
             lease_sec=lease_sec, breaker_failures=breaker_failures,
             breaker_cooldown_sec=breaker_cooldown_sec,
             slow_eject_factor=slow_eject_factor,
             slow_eject_cooldown_sec=slow_eject_cooldown_sec)
+        # per-tenant quotas (?model= names the tenant): in-flight cap
+        # and token-bucket rate limit, both 0 = disabled
+        self.quotas = TenantQuotas(inflight_limit=tenant_inflight,
+                                   rate=tenant_rate, burst=tenant_burst)
+        # membership snapshot for zero-downtime restart: written
+        # (CRC-footered, atomic+fsync) on register/deregister and each
+        # health pass, restored — with fresh leases — on startup
+        self.state_path = str(state_path)
         self.hc_sec = float(hc_sec)
         self.inflight_budget = int(inflight_budget)
         # default end-to-end budget stamped on requests that carry no
@@ -405,6 +479,49 @@ class FleetRouter:
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
         self._shut = False
+        self._restore_state()
+
+    # ----------------------------------------------------- state snapshot
+    def save_state(self) -> None:
+        """Persist the membership table (atomic, fsync'd, CRC-footered
+        like every other durable artifact).  Best-effort: a full disk
+        must not fail a registration."""
+        if not self.state_path:
+            return
+        from xgboost_tpu.reliability.integrity import (add_footer,
+                                                       atomic_write)
+        try:
+            atomic_write(
+                self.state_path,
+                add_footer(json.dumps(self.membership.snapshot(),
+                                      sort_keys=True).encode()))
+        except OSError as e:
+            from xgboost_tpu.obs.metrics import swallowed_error
+            swallowed_error("fleet.router.save_state", e)
+
+    def _restore_state(self) -> None:
+        """Zero-downtime restart: re-register every snapshotted replica
+        with a fresh lease, so a SIGKILL'd router comes back already
+        routing.  A corrupt/absent snapshot starts empty — replicas
+        re-register within a heartbeat period anyway (the recover
+        path); restore just removes that window."""
+        if not self.state_path or not os.path.exists(self.state_path):
+            return
+        try:
+            from xgboost_tpu.reliability.integrity import \
+                verify_model_bytes
+            with open(self.state_path, "rb") as f:
+                payload = verify_model_bytes(f.read(), self.state_path)
+            n = self.membership.restore(json.loads(payload))
+            from xgboost_tpu.obs import event
+            event("fleet.router.restore", replicas=n,
+                  state_path=self.state_path)
+            if not self.quiet:
+                print(f"[fleet] restored {n} replica(s) from "
+                      f"{self.state_path}", file=sys.stderr)
+        except Exception as e:
+            from xgboost_tpu.obs.metrics import swallowed_error
+            swallowed_error("fleet.router.restore_state", e)
 
     # -------------------------------------------------------- admission
     def enter_request(self) -> bool:
@@ -496,7 +613,8 @@ class FleetRouter:
 
     def dispatch(self, method: str, path_qs: str, body: bytes,
                  headers: Dict[str, str], sp=None,
-                 deadline: Optional[Deadline] = None
+                 deadline: Optional[Deadline] = None,
+                 model: str = ""
                  ) -> Tuple[int, Dict[str, str], bytes]:
         """Route one LEAST-LOADED request (`/predict`): forward, and —
         on failure — retry ONCE on a different replica (predictions are
@@ -531,7 +649,7 @@ class FleetRouter:
                     # retries in lockstep re-overloads the survivor),
                     # bounded so it never eats the remaining budget
                     time.sleep(backoff_delay(attempt, deadline=deadline))
-                rep = self.membership.acquire(exclude=tried)
+                rep = self.membership.acquire(exclude=tried, model=model)
                 if rep is None:
                     break
                 tried.append(rep.replica_id)
@@ -586,7 +704,8 @@ class FleetRouter:
     # ----------------------------------------------- id-keyed dispatching
     def dispatch_by_id(self, path: str, path_qs: str, body: bytes,
                        headers: Dict[str, str], sp=None,
-                       deadline: Optional[Deadline] = None
+                       deadline: Optional[Deadline] = None,
+                       model: str = ""
                        ) -> Tuple[int, Dict[str, str], bytes]:
         """Consistent-hash dispatch for the entity-id routes.  The
         common case — every id owned by one replica — forwards the body
@@ -608,7 +727,9 @@ class FleetRouter:
         ids = req.get("ids")
         if not isinstance(ids, list) or not ids:
             raise ValueError("'ids' must be a non-empty list")
-        groups = self.membership.route_ids(ids)
+        # per-(model, entity) ownership: each tenant's hot rows
+        # concentrate independently, on replicas hosting that model
+        groups = self.membership.route_ids(ids, model=model)
         if not groups:
             raise NoReplica()
         if len(groups) == 1:
@@ -803,6 +924,7 @@ class FleetRouter:
             "registered": desc["registered"],
             "inflight": self._inflight,
             "inflight_budget": self.inflight_budget,
+            "models": self.membership.models_hosted(),
             "uptime_seconds": round(time.perf_counter() - self.t0, 3),
         }
 
@@ -818,7 +940,7 @@ class FleetRouter:
                                     state=self._rollout_state)
             kw = dict(self.rollout_defaults)
             for k in ("canaries", "soak_sec", "gate_error_rate",
-                      "gate_p99_ms"):
+                      "gate_p99_ms", "model"):
                 if k in req:
                     kw[k] = req[k]
             report = ctl.rollout(model_path, **kw)
@@ -834,7 +956,7 @@ class FleetRouter:
         finally:
             self._rollout_lock.release()
 
-    def run_rollback(self) -> Tuple[int, dict]:
+    def run_rollback(self, model: str = "") -> Tuple[int, dict]:
         from xgboost_tpu.fleet.rollout import RolloutController
         # serialized against rollouts: a rollback racing an in-flight
         # rollout's fleet push would interleave writes to the same
@@ -847,7 +969,7 @@ class FleetRouter:
         try:
             ctl = RolloutController(self.membership, self._forward,
                                     state=self._rollout_state)
-            report = ctl.rollback()
+            report = ctl.rollback(model=model)
             with self._inflight_lock:
                 self._last_rollout = report
             return 200, report
@@ -866,6 +988,10 @@ class FleetRouter:
             try:
                 self.membership.health_check()
                 self._pool.prune(self.membership.urls())
+                # advertisement drift (a rollout moved a tenant's hash)
+                # arrives on heartbeats; fold it into the snapshot here
+                # rather than fsync-ing on every heartbeat
+                self.save_state()
             except Exception as e:  # the health loop must survive anything
                 from xgboost_tpu.obs.metrics import swallowed_error
                 swallowed_error("fleet.router.health_loop", e)
@@ -927,6 +1053,9 @@ def run_router(host: str = "127.0.0.1", port: int = 8000,
                slow_eject_factor: float = 3.0,
                slow_eject_cooldown_sec: float = 5.0,
                rollout_defaults: Optional[dict] = None,
+               state_path: str = "",
+               tenant_inflight: int = 0, tenant_rate: float = 0.0,
+               tenant_burst: float = 8.0,
                quiet: bool = False, block: bool = True
                ) -> Optional[FleetRouter]:
     """Build and run the fleet router (CLI ``task=fleet_router``).
@@ -939,7 +1068,11 @@ def run_router(host: str = "127.0.0.1", port: int = 8000,
                      max_body_mb=max_body_mb, deadline_ms=deadline_ms,
                      slow_eject_factor=slow_eject_factor,
                      slow_eject_cooldown_sec=slow_eject_cooldown_sec,
-                     rollout_defaults=rollout_defaults, quiet=quiet)
+                     rollout_defaults=rollout_defaults,
+                     state_path=state_path,
+                     tenant_inflight=tenant_inflight,
+                     tenant_rate=tenant_rate, tenant_burst=tenant_burst,
+                     quiet=quiet)
     if not quiet:
         print(f"[fleet] router on http://{rt.host}:{rt.port} "
               f"(lease {lease_sec}s, budget {inflight_budget} in-flight)",

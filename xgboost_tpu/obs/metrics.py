@@ -814,6 +814,112 @@ def fleet_metrics() -> FleetMetrics:
     return _FLEET
 
 
+# ----------------------------------------------------------------- catalog
+class CatalogMetrics:
+    """Replica-side model-catalog accounting (``xgbtpu_catalog_*``,
+    SERVING.md catalog section): how many models are configured vs
+    actually resident, where the shared device budget stands, and the
+    admission/eviction churn of the cold tail.  One instance per
+    process (:func:`catalog_metrics`); rendered into every /metrics
+    body via the registry."""
+
+    def __init__(self, prefix: str = "xgbtpu_catalog"):
+        p = prefix
+        self.models_configured = Gauge(
+            f"{p}_models_configured",
+            "models named in this replica's catalog manifest")
+        self.models_resident = Gauge(
+            f"{p}_models_resident",
+            "models with a live engine on device right now")
+        self.bytes_used = Gauge(
+            f"{p}_bytes_used",
+            "estimated device bytes held by resident model engines")
+        self.bytes_budget = Gauge(
+            f"{p}_bytes_budget",
+            "serve_catalog_mb budget in bytes (0 = unlimited)")
+        self.admissions = Counter(
+            f"{p}_admissions_total",
+            "evicted models re-built and re-warmed on demand")
+        self.evictions = Counter(
+            f"{p}_evictions_total",
+            "cold models' engines LRU-evicted to fit the budget")
+        self.requests = LabeledCounter(
+            f"{p}_requests_total", "model",
+            "catalog resolves served, by model name")
+        self.unknown_model = Counter(
+            f"{p}_unknown_model_total",
+            "requests naming a model the catalog does not hold (404)")
+        self._all = (self.models_configured, self.models_resident,
+                     self.bytes_used, self.bytes_budget, self.admissions,
+                     self.evictions, self.requests, self.unknown_model)
+        registry().register("catalog", self.render)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._all)
+
+
+_CATALOG: Optional[CatalogMetrics] = None
+_CATALOG_LOCK = threading.Lock()
+
+
+def catalog_metrics() -> CatalogMetrics:
+    """The process-wide CatalogMetrics singleton."""
+    global _CATALOG
+    if _CATALOG is None:
+        with _CATALOG_LOCK:
+            if _CATALOG is None:
+                _CATALOG = CatalogMetrics()
+    return _CATALOG
+
+
+# ------------------------------------------------------------------ tenant
+class TenantMetrics:
+    """Router-side per-tenant accounting (``xgbtpu_tenant_*``,
+    SERVING.md catalog section): request/shed/latency per model name at
+    the front door, so one tenant's overload is attributable — and
+    provably isolated — at a glance.  Latency is a labeled
+    milliseconds-sum counter; pair with ``requests_total`` for the
+    per-tenant mean (per-tenant quantiles live in the bench/chaos
+    reports, which sample client-side).  One instance per process
+    (:func:`tenant_metrics`)."""
+
+    def __init__(self, prefix: str = "xgbtpu_tenant"):
+        p = prefix
+        self.requests = LabeledCounter(
+            f"{p}_requests_total", "model",
+            "requests entering the router, by model name")
+        self.shed = LabeledCounter(
+            f"{p}_shed_total", "model",
+            "requests shed by that tenant's quota (429 rate / "
+            "503 in-flight)")
+        self.latency_ms = LabeledCounter(
+            f"{p}_latency_ms_total", "model",
+            "cumulative router-side request milliseconds, by model")
+        self.inflight = LabeledGauge(
+            f"{p}_inflight", "model",
+            "requests currently in flight through the router, by model")
+        self._all = (self.requests, self.shed, self.latency_ms,
+                     self.inflight)
+        registry().register("tenant", self.render)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._all)
+
+
+_TENANT: Optional[TenantMetrics] = None
+_TENANT_LOCK = threading.Lock()
+
+
+def tenant_metrics() -> TenantMetrics:
+    """The process-wide TenantMetrics singleton."""
+    global _TENANT
+    if _TENANT is None:
+        with _TENANT_LOCK:
+            if _TENANT is None:
+                _TENANT = TenantMetrics()
+    return _TENANT
+
+
 # ----------------------------------------------------------------- serving
 class ServingMetrics:
     """Metric registry for the serving subsystem (see SERVING.md for the

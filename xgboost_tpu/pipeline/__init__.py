@@ -42,11 +42,13 @@ def run_pipeline(publish_path: str, workdir: str = "./pipeline",
                  sleep_sec: float = 0.0,
                  params: Optional[dict] = None,
                  source: Optional[DataSource] = None,
-                 quiet: bool = False) -> dict:
+                 quiet: bool = False, lane: str = "") -> dict:
     """Assemble the default pipeline from flat knob values (the CLI
     ``task=pipeline`` surface — every ``PIPELINE_PARAMS`` key maps to
     one argument) and run it.  ``source`` overrides the file seam for
-    embedders."""
+    embedders.  ``lane`` names the catalog tenant this pipeline trains:
+    its events are lane-tagged and a router publish is scoped to that
+    model's hosting replicas (per-tenant rollout)."""
     if not publish_path:
         raise ValueError("pipeline_publish_path is required")
     if source is None:
@@ -58,17 +60,60 @@ def run_pipeline(publish_path: str, workdir: str = "./pipeline",
     gate = EvalGate(metric=metric, min_delta=min_delta,
                     max_regression=max_regression)
     publisher = (RolloutPublisher(publish_path, router_url,
-                                  timeout=publish_timeout_sec)
+                                  timeout=publish_timeout_sec,
+                                  model=lane)
                  if router_url else Publisher(publish_path))
     trainer = ContinuousTrainer(
         publish_path, source, workdir,
         rounds_per_cycle=rounds_per_cycle, params=params, gate=gate,
-        publisher=publisher, quiet=quiet)
+        publisher=publisher, quiet=quiet, lane=lane)
     return trainer.run(cycles=cycles, sleep_sec=sleep_sec)
+
+
+def run_tenant_lanes(lanes: dict, quiet: bool = False) -> dict:
+    """Run one training lane per catalog tenant, concurrently.
+
+    ``lanes`` maps a tenant/model name to a :func:`run_pipeline` kwargs
+    dict (each lane needs its OWN ``publish_path``/``workdir``; the
+    lane name is injected as ``lane=`` unless the kwargs override it).
+    Every lane keeps the full single-pipeline crash discipline — its
+    own fsync'd ``gated.log`` ledger, quarantine dir, and checkpoint
+    ring live under its own workdir, so the zero-ungated-models
+    contract holds PER TENANT.  Lanes are isolated: one lane raising
+    (or gate-failing forever) never stalls or poisons its neighbors —
+    the error is contained in that lane's summary entry.
+    """
+    import threading
+
+    from xgboost_tpu.obs import event
+    results: dict = {}
+
+    def _one(name: str, kw: dict) -> None:
+        kw = dict(kw)
+        kw.setdefault("lane", name)
+        kw.setdefault("quiet", quiet)
+        try:
+            results[name] = {"status": "ok",
+                             "summary": run_pipeline(**kw)}
+        except Exception as e:  # lane isolation: never kill siblings
+            results[name] = {"status": "error",
+                             "error": f"{type(e).__name__}: {e}"}
+            event("pipeline.lane_error", lane=name,
+                  error=f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=_one, args=(name, kw),
+                                name=f"lane-{name}", daemon=True)
+               for name, kw in lanes.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
 
 
 __all__ = [
     "ContinuousTrainer", "DataSource", "FileDataSource",
     "SyntheticDataSource", "CallableDataSource", "EvalGate",
     "Publisher", "RolloutPublisher", "PublishRejected", "run_pipeline",
+    "run_tenant_lanes",
 ]

@@ -81,7 +81,7 @@ class RolloutPublisher(Publisher):
     def __init__(self, publish_path: str, router_url: str,
                  canaries: Optional[int] = None,
                  soak_sec: Optional[float] = None,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0, model: str = ""):
         # timeout must outlive the router's soak window (the POST
         # blocks through canary push + soak + gate + fleet push); a
         # timeout mid-soak would count a succeeding rollout as a
@@ -91,6 +91,9 @@ class RolloutPublisher(Publisher):
         self.canaries = canaries
         self.soak_sec = soak_sec
         self.timeout = timeout
+        # catalog tenant: the router scopes the rollout to replicas
+        # hosting this model and pushes to THEIR per-model paths
+        self.model = model
 
     def _rollout_call(self, payload: dict) -> dict:
         import http.client
@@ -124,6 +127,8 @@ class RolloutPublisher(Publisher):
                   model_hash=digest, lane="rollout"):
             atomic_write(stage, raw)  # router-visible, poller-invisible
             payload: dict = {"model_path": stage}
+            if self.model:
+                payload["model"] = self.model
             if self.canaries is not None:
                 payload["canaries"] = int(self.canaries)
             if self.soak_sec is not None:

@@ -62,12 +62,15 @@ class ContinuousTrainer:
                  params: Optional[dict] = None,
                  gate: Optional[EvalGate] = None,
                  publisher: Optional[Publisher] = None,
-                 quiet: bool = False):
+                 quiet: bool = False, lane: str = ""):
         self.publish_path = publish_path
         self.source = source
         self.workdir = workdir
         self.rounds_per_cycle = int(rounds_per_cycle)
         self.params = dict(params or {})
+        # tenant lane name: tags every pipeline event/log line so N
+        # concurrent per-model lanes stay attributable in one stream
+        self.lane = lane
         self.gate = gate if gate is not None else EvalGate()
         self.publisher = (publisher if publisher is not None
                           else Publisher(publish_path))
@@ -101,9 +104,15 @@ class ContinuousTrainer:
         atomic_write(self.state_path,
                      (json.dumps(st, sort_keys=True) + "\n").encode())
 
+    def _event(self, name: str, **kw) -> None:
+        if self.lane:
+            kw.setdefault("lane", self.lane)
+        event(name, **kw)
+
     def _say(self, msg: str) -> None:
         if not self.quiet:
-            print(f"[pipeline] {msg}", file=sys.stderr)
+            tag = f"pipeline:{self.lane}" if self.lane else "pipeline"
+            print(f"[{tag}] {msg}", file=sys.stderr)
 
     def _data(self, cycle: int):
         """Memoized per-cycle (dtrain, dholdout): the gate runs in the
@@ -167,7 +176,7 @@ class ContinuousTrainer:
         except OSError:
             qpath = None  # xgtpu: disable=XGT004 — restore still heals
         atomic_write(self.publish_path, raw)
-        event("pipeline.incumbent_restored", path=self.publish_path,
+        self._event("pipeline.incumbent_restored", path=self.publish_path,
               quarantined_as=qpath, cause=str(cause))
         self._say(f"publish path failed verification ({cause}); "
                   "restored the last published model from the backup")
@@ -199,7 +208,7 @@ class ContinuousTrainer:
                                              dict(self.params))
             if appended:
                 self.metrics.resumes.inc()
-                event("pipeline.resume", cycle=cycle, phase="train",
+                self._event("pipeline.resume", cycle=cycle, phase="train",
                       appended_rounds=appended)
                 self._say(f"cycle {cycle}: resumed mid-train at "
                           f"appended round {appended}")
@@ -262,7 +271,7 @@ class ContinuousTrainer:
             verdict = self._judge_vs_incumbent(cand, holdout, cycle)
             verdict["verified"] = True
             verdict["model_hash"] = hashlib.sha256(raw).hexdigest()
-        event("pipeline.gate", cycle=cycle, passed=verdict["passed"],
+        self._event("pipeline.gate", cycle=cycle, passed=verdict["passed"],
               metric=verdict.get("metric"),
               candidate=verdict.get("candidate"),
               incumbent=verdict.get("incumbent"),
@@ -327,7 +336,7 @@ class ContinuousTrainer:
             i += 1
         os.replace(self.candidate_path, dest)
         self.metrics.quarantines.inc()
-        event("pipeline.quarantine", cycle=cycle, quarantined_as=dest,
+        self._event("pipeline.quarantine", cycle=cycle, quarantined_as=dest,
               reason=verdict.get("reason"))
         self._say(f"cycle {cycle}: candidate quarantined as {dest} "
                   f"({verdict.get('reason')})")
@@ -410,7 +419,7 @@ class ContinuousTrainer:
         re-stamp the metrics the dead process took with it."""
         self._refresh_backup()
         self.metrics.note_publish()
-        event("pipeline.publish", path=self.publish_path,
+        self._event("pipeline.publish", path=self.publish_path,
               model_hash=model_hash, resumed=True)
 
     # --------------------------------------------------------------- cycle
@@ -435,7 +444,7 @@ class ContinuousTrainer:
                     # died past training: RE-GATE the candidate from its
                     # bytes — a pre-crash verdict is not trusted
                     pm.resumes.inc()
-                    event("pipeline.resume", cycle=cycle, phase=phase)
+                    self._event("pipeline.resume", cycle=cycle, phase=phase)
                     done_hash = self._already_published()
                     if done_hash is not None:
                         # the crash landed BETWEEN a completed publish
@@ -513,7 +522,7 @@ class ContinuousTrainer:
                 out = self.run_cycle()
             except Exception as e:
                 summary["errors"] += 1
-                event("pipeline.cycle_error",
+                self._event("pipeline.cycle_error",
                       error=f"{type(e).__name__}: {e}")
                 self._say(f"cycle error ({type(e).__name__}: {e}); "
                           "will retry from the persisted phase")

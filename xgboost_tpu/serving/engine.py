@@ -385,6 +385,25 @@ class PredictEngine:
     def num_compiled(self) -> int:
         return len(self._compiled)
 
+    def device_bytes(self) -> int:
+        """Estimated device bytes this engine pins: the uploaded tree
+        stack + cut matrix + cached base blocks, plus per-compiled-
+        bucket operand/result buffers.  An estimate (XLA's own
+        executable footprint is not visible from here), but consistent
+        across models — what the catalog's shared ``serve_catalog_mb``
+        budget meters (catalog/catalog.py)."""
+        import jax
+        n = 0
+        for leaf in jax.tree_util.tree_leaves((self._stack, self._group)):
+            n += getattr(leaf, "nbytes", 0)
+        n += getattr(self._cuts_dev, "nbytes", 0)
+        for base in self._base_cache.values():
+            n += getattr(base, "nbytes", 0)
+        F, K = self.cuts.num_feature, self._K
+        for bucket in self._compiled:
+            n += bucket * (F + K) * 4  # f32 operand + margin per bucket
+        return int(n)
+
     def describe(self) -> dict:
         return {
             "buckets": list(self.buckets),

@@ -101,6 +101,13 @@ class FeatureStore:
     def resident_bytes(self) -> int:
         return len(self._slots) * self.num_feature * 4
 
+    def device_bytes(self) -> int:
+        """Actual device bytes of the pinned slab (allocated up front,
+        independent of how many slots are filled) — what per-model
+        catalog rows report next to the engine estimate."""
+        return int(getattr(self._slab, "nbytes",
+                           (self.capacity + 1) * self.num_feature * 4))
+
     def ids(self) -> List[str]:
         with self._lock:
             return list(self._slots)

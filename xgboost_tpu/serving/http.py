@@ -7,6 +7,12 @@ Endpoints (SERVING.md):
   ``{"predictions": [...], "model_version": v, "rows": n}``.
   ``?output_margin=1`` returns raw margins.  A full batch queue maps to
   HTTP 503 (the batcher's reject-with-backpressure contract).
+  ``?model=NAME`` selects a model from the replica's catalog
+  (xgboost_tpu.catalog); the bare path resolves to the configured
+  default model — the catalog-of-one path IS the single-model path.
+  An unknown model name is 404.  ``?model=`` also applies to
+  ``/predict_by_id``, the ``/featurestore/*`` admin routes, and
+  ``/-/reload`` / ``/-/rollback``.
 - ``POST /predict_by_id`` — JSON ``{"ids": [...]}``: predictions for
   DEVICE-RESIDENT entities (serving/featurestore.py) with zero
   host→device feature bytes; absent ids → 404 listing them.  Enabled
@@ -207,6 +213,16 @@ class _Handler(BaseHTTPRequestHandler):
             }
             if ps.featurestore is not None:
                 health["featurestore_rows"] = len(ps.featurestore)
+            if ps.catalog is not None:
+                # per-model rows (name -> path/resident/version/hash/
+                # buckets/device bytes) — the rollout controller verifies
+                # per-tenant pushes against models[m]["model_hash"]
+                cd = ps.catalog.describe()
+                health["models"] = cd["models"]
+                health["catalog"] = {
+                    k: cd[k] for k in ("default", "configured",
+                                       "resident", "bytes_used",
+                                       "bytes_budget")}
             self._send_json(200, health)
             return
         if url.path == "/metrics":
@@ -246,27 +262,66 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             try:
                 if url.path == "/featurestore/put":
-                    self._featurestore_put(body)
+                    self._featurestore_put(url, body)
                 else:
-                    self._featurestore_invalidate(body)
+                    self._featurestore_invalidate(url, body)
             finally:
                 ps.exit_request()
             return
         if url.path == "/-/reload":
             # forced: bypasses the poisoned-fingerprint skip, so an
-            # operator can retry after a TRANSIENT build failure
-            reloaded = self.server.registry.check_reload(force=True)
+            # operator can retry after a TRANSIENT build failure.
+            # ?model= scopes the reload to one catalog entry (the
+            # per-tenant rollout path); bare = the default model
+            reg = self._resolve_registry(url)
+            if reg is None:
+                return
+            reloaded = reg.check_reload(force=True)
             self._send_json(200, {"reloaded": reloaded,
-                                  "model_version":
-                                      self.server.registry.version})
+                                  "model_version": reg.version})
             return
         if url.path == "/-/rollback":
-            ok = self.server.registry.rollback()
+            reg = self._resolve_registry(url)
+            if reg is None:
+                return
+            ok = reg.rollback()
             self._send_json(200 if ok else 409,
                             {"rolled_back": ok,
-                             "model_version": self.server.registry.version})
+                             "model_version": reg.version})
             return
         self._send_json(404, {"error": f"no route {url.path}"})
+
+    # ------------------------------------------------------------ catalog
+    def _resolve_entry(self, url, sp=None):
+        """``(registry, batcher, entry)`` for the request's ``?model=``
+        (entry is None on a catalog-less server).  On an unknown model
+        a 404 naming the known set is already sent and ``(None, None,
+        None)`` returns — mirroring the router's UnknownModel answer so
+        clients see one shape fleet-wide."""
+        from xgboost_tpu.catalog import UnknownModel
+        model = parse_qs(url.query).get("model", [""])[0]
+        ps: PredictServer = self.server.pserver
+        try:
+            return ps.resolve_model(model)
+        except UnknownModel as e:
+            from xgboost_tpu.obs.metrics import catalog_metrics
+            catalog_metrics().unknown_model.inc()
+            if sp is not None:
+                sp.set("status", 404)
+            self._send_json(404, {"error": str(e), "models": e.known})
+            return None, None, None
+        except Exception as e:
+            # admission failed (bad model file, device OOM building the
+            # engine): the model EXISTS but cannot serve right now
+            if sp is not None:
+                sp.set("status", 503)
+            self._send_json(503, {"error": f"model {model!r} failed to "
+                                           f"load: {e}"})
+            return None, None, None
+
+    def _resolve_registry(self, url, sp=None):
+        reg, _, _ = self._resolve_entry(url, sp)
+        return reg
 
     def _predict(self, url, body: str) -> None:
         # request tracing (OBSERVABILITY.md): the caller's X-Request-Id
@@ -315,6 +370,14 @@ class _Handler(BaseHTTPRequestHandler):
             # client's) says nobody is waiting for this answer
             self._deadline_reject("deadline expired on arrival", dl, sp)
             return
+        # model resolution BEFORE body parsing: admission of a cold
+        # catalog entry (engine build + warmup) is the expensive step,
+        # and an unknown model must 404 without paying any parse cost
+        reg, batcher, entry = self._resolve_entry(url, sp)
+        if reg is None:
+            return
+        if sp is not None and entry is not None:
+            sp.set("model", entry.name)
         try:
             qs = parse_qs(url.query)
             fmt = qs.get("format", [None])[0]
@@ -322,7 +385,6 @@ class _Handler(BaseHTTPRequestHandler):
                 ctype = (self.headers.get("Content-Type") or "").lower()
                 fmt = "libsvm" if "libsvm" in ctype else "csv"
             output_margin = qs.get("output_margin", ["0"])[0] in ("1", "true")
-            reg: ModelRegistry = self.server.registry
             if fmt == "libsvm":
                 X = parse_libsvm_rows(body, reg.engine.num_feature)
             elif fmt == "csv":
@@ -373,8 +435,8 @@ class _Handler(BaseHTTPRequestHandler):
             time.sleep(wedge)
         t_submit = time.perf_counter()
         try:
-            preds = self.server.batcher.submit(X, output_margin=output_margin,
-                                               deadline=dl)
+            preds = batcher.submit(X, output_margin=output_margin,
+                                   deadline=dl)
         except QueueFull as e:
             _st(503)
             self._send_json(503, {"error": str(e)})
@@ -405,15 +467,26 @@ class _Handler(BaseHTTPRequestHandler):
         _st(200)
         if sp is not None:
             sp.set("model_version", int(version))
-        self._send_json(200, {"predictions": np.asarray(preds).tolist(),
-                              "model_version": version,
-                              "rows": int(X.shape[0])})
+        resp = {"predictions": np.asarray(preds).tolist(),
+                "model_version": version,
+                "rows": int(X.shape[0])}
+        if entry is not None:
+            resp["model"] = entry.name
+        self._send_json(200, resp)
 
 
     # -------------------------------------------------- feature store
-    def _store(self):
-        """The server's FeatureStore, or None + a 404 already sent."""
-        store = self.server.pserver.featurestore
+    def _entry_store(self, entry):
+        """The FeatureStore serving ``entry`` (the default model rides
+        the server-level store; other catalog entries own per-model
+        stores), or None + a 404 already sent."""
+        ps: PredictServer = self.server.pserver
+        if entry is None or (ps.catalog is not None
+                             and entry.name == ps.catalog.default):
+            store = (ps.featurestore_for()
+                     if ps.featurestore is not None else None)
+        else:
+            store = entry.featurestore_for()
         if store is None:
             self._send_json(404, {
                 "error": "feature store disabled "
@@ -450,7 +523,12 @@ class _Handler(BaseHTTPRequestHandler):
         if dl is not None and dl.expired():
             self._deadline_reject("deadline expired on arrival", dl, sp)
             return
-        store = self._store()
+        reg, _, entry = self._resolve_entry(url, sp)
+        if reg is None:
+            return
+        if sp is not None and entry is not None:
+            sp.set("model", entry.name)
+        store = self._entry_store(entry)
         if store is None:
             _st(404)
             return
@@ -473,14 +551,16 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if sp is not None:
             sp.set("rows", len(ids))
-        reg: ModelRegistry = self.server.registry
         # (version, engine) resolved atomically: the response names the
         # model that actually ran, across hot-reloads — and a reload's
         # new cuts rebin the SAME resident raw rows on device.  A
         # reload that changed the FEATURE WIDTH swaps the store (empty,
         # same budget): these ids then 404 as misses, not shape errors
         version, engine = reg.current()
-        store = self.server.pserver.featurestore_for()
+        store = self._entry_store(entry)
+        if store is None:
+            _st(404)
+            return
         if store.num_feature != engine.num_feature:
             # the engine snapshot raced a width-changing reload:
             # re-resolve once (the store swap keyed on the registry's
@@ -506,17 +586,22 @@ class _Handler(BaseHTTPRequestHandler):
         _st(200)
         if sp is not None:
             sp.set("model_version", int(version))
-        self._send_json(200, {"predictions": np.asarray(preds).tolist(),
-                              "model_version": version,
-                              "rows": len(ids)})
+        resp = {"predictions": np.asarray(preds).tolist(),
+                "model_version": version,
+                "rows": len(ids)}
+        if entry is not None:
+            resp["model"] = entry.name
+        self._send_json(200, resp)
 
-    def _featurestore_put(self, body: str) -> None:
-        store = self._store()
-        if store is None:
+    def _featurestore_put(self, url, body: str) -> None:
+        reg, _, entry = self._resolve_entry(url)
+        if reg is None:
             return
         # puts validate against the CURRENT model's width (a width-
         # changing hot-reload swaps in a fresh store of the new width)
-        store = self.server.pserver.featurestore_for()
+        store = self._entry_store(entry)
+        if store is None:
+            return
         try:
             req = json.loads(body)
             ids, rows = req["ids"], req["rows"]
@@ -537,8 +622,11 @@ class _Handler(BaseHTTPRequestHandler):
         res.update(store.describe())
         self._send_json(200, res)
 
-    def _featurestore_invalidate(self, body: str) -> None:
-        store = self._store()
+    def _featurestore_invalidate(self, url, body: str) -> None:
+        reg, _, entry = self._resolve_entry(url)
+        if reg is None:
+            return
+        store = self._entry_store(entry)
         if store is None:
             return
         try:
@@ -575,10 +663,16 @@ class PredictServer:
     def __init__(self, registry: ModelRegistry, batcher: MicroBatcher,
                  metrics, host: str = "127.0.0.1", port: int = 8080,
                  quiet: bool = True, drain_grace: float = 30.0,
-                 max_body_mb: float = 64.0, featurestore=None):
+                 max_body_mb: float = 64.0, featurestore=None,
+                 catalog=None):
         self.registry = registry
         self.batcher = batcher
         self.metrics = metrics
+        # optional ModelCatalog (xgboost_tpu.catalog): N named models on
+        # this replica, resolved by ?model=.  registry/batcher above stay
+        # the DEFAULT entry's — every existing single-model caller sees
+        # the same attributes whether or not a catalog is attached
+        self.catalog = catalog
         # optional device-resident FeatureStore (serving/featurestore.py)
         # backing /predict_by_id and the /featurestore/* admin routes;
         # access through featurestore_for() on model-facing paths so a
@@ -617,6 +711,22 @@ class PredictServer:
         self._httpd.pserver = self
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ catalog
+    def resolve_model(self, name: str = ""):
+        """``(registry, batcher, entry)`` serving model ``name`` (the
+        default model when empty).  Without a catalog only the bare
+        path exists — a named model raises UnknownModel (the handler's
+        404).  With one, a cold entry is admitted on demand (engine
+        build + warmup happen on THIS request's thread; hot models are
+        a dict probe)."""
+        if self.catalog is None:
+            if name:
+                from xgboost_tpu.catalog import UnknownModel
+                raise UnknownModel(name, [])
+            return self.registry, self.batcher, None
+        entry = self.catalog.resolve(name)
+        return entry.registry, entry.batcher, entry
 
     # ------------------------------------------------------ feature store
     def featurestore_for(self):
@@ -731,6 +841,11 @@ class PredictServer:
             router_url, rid, self_url,
             model_path=self.registry.path,
             model_hash_fn=lambda: self.registry.content_hash,
+            # catalog advertisement: every heartbeat carries the model
+            # set (name -> path/hash) so the router can route ?model=
+            # to replicas that actually HOST the model
+            models_fn=(self.catalog.models
+                       if self.catalog is not None else None),
             on_kill=on_kill)
 
     # -------------------------------------------------------- drain state
@@ -806,6 +921,8 @@ class PredictServer:
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "PredictServer":
         self.registry.start()
+        if self.catalog is not None:
+            self.catalog.start()  # idempotent for the default registry
         if self.lease_client is not None:
             self.lease_client.start()
         self._thread = threading.Thread(
@@ -816,6 +933,8 @@ class PredictServer:
 
     def serve_forever(self) -> None:
         self.registry.start()
+        if self.catalog is not None:
+            self.catalog.start()
         if self.lease_client is not None:
             self.lease_client.start()
         if threading.current_thread() is threading.main_thread():
@@ -839,6 +958,8 @@ class PredictServer:
         if self.lease_client is not None:
             self.lease_client.stop(deregister=True)
         self.registry.stop()
+        if self.catalog is not None:
+            self.catalog.stop()  # re-stop of the default entry is a no-op
         self._httpd.shutdown()
         self._httpd.server_close()
         self.batcher.close()
@@ -847,56 +968,120 @@ class PredictServer:
             self._thread = None
 
 
-def run_server(model_path: str, host: str = "127.0.0.1", port: int = 8080,
+def run_server(model_path: str = "", host: str = "127.0.0.1",
+               port: int = 8080,
                min_bucket: int = 8, max_bucket: int = 8192,
                max_batch_rows: int = 1024, max_wait_ms: float = 2.0,
                max_queue_rows: int = 8192, poll_sec: float = 1.0,
                keep_versions: int = 2, warmup: bool = True,
                drain_sec: float = 30.0, max_body_mb: float = 64.0,
                featurestore_mb: float = 0.0,
+               catalog: str = "", catalog_default: str = "",
+               catalog_mb: float = 0.0,
+               catalog_hysteresis_sec: float = 3.0,
                router_url: str = "", replica_id: str = "",
                advertise_url: str = "",
                quiet: bool = False,
                block: bool = True) -> Optional[PredictServer]:
-    """Build the full serving stack for one model file and run it.
+    """Build the full serving stack and run it.
+
+    Every server is a catalog server: ``model_path`` alone is a
+    catalog of one (entry name ``default``, bare ``/predict`` hits
+    it — byte-identical behavior to the pre-catalog stack).
+    ``catalog`` adds named models (inline ``name=path,...`` or a
+    manifest file, see :func:`xgboost_tpu.catalog.parse_manifest`),
+    all admitted under one ``catalog_mb`` device budget with
+    LRU-evict + ``catalog_hysteresis_sec`` anti-thrash;
+    ``catalog_default`` picks which entry bare requests resolve to.
 
     ``featurestore_mb > 0`` attaches a device-resident
     :class:`~xgboost_tpu.serving.featurestore.FeatureStore` of that
-    byte budget, enabling ``POST /predict_by_id`` (zero-upload repeat
-    traffic) and the ``/featurestore/*`` admin routes.
+    byte budget PER MODEL, enabling ``POST /predict_by_id``
+    (zero-upload repeat traffic) and the ``/featurestore/*`` admin
+    routes.
 
     ``router_url`` joins a fleet (xgboost_tpu.fleet): the replica
-    registers with the router there, heartbeats a lease, and
-    deregisters when draining.
+    registers with the router there, heartbeats a lease (advertising
+    its model set), and deregisters when draining.
 
     With ``block=False`` the server runs on a background thread and the
     :class:`PredictServer` is returned (tests, embedding)."""
+    from xgboost_tpu.catalog import ModelCatalog, parse_manifest
     from xgboost_tpu.profiling import ServingMetrics
     metrics = ServingMetrics()
-    registry = ModelRegistry(model_path, keep_versions=keep_versions,
+    manifest = parse_manifest(catalog) if catalog else {}
+    default_name = catalog_default or ("default" if model_path
+                                       else next(iter(manifest), ""))
+    paths = dict(manifest)
+    if model_path:
+        # an explicit model_in IS the default model, even when the
+        # manifest also names one under default_name
+        paths[default_name] = model_path
+    if not paths:
+        raise ValueError("run_server needs model_in or a catalog= "
+                         "manifest")
+    if default_name not in paths:
+        raise ValueError(f"catalog_default {default_name!r} is not in "
+                         f"the catalog (holds: {sorted(paths)})")
+
+    def registry_factory(path):
+        return ModelRegistry(path, keep_versions=keep_versions,
                              warmup=warmup, poll_sec=poll_sec,
                              metrics=metrics, min_bucket=min_bucket,
                              max_bucket=max_bucket)
-    batcher = MicroBatcher(registry.predict, max_batch_rows=max_batch_rows,
-                           max_wait_ms=max_wait_ms,
-                           max_queue_rows=max_queue_rows, metrics=metrics)
+
+    def batcher_factory(reg):
+        return MicroBatcher(reg.predict, max_batch_rows=max_batch_rows,
+                            max_wait_ms=max_wait_ms,
+                            max_queue_rows=max_queue_rows,
+                            metrics=metrics)
+
+    registry = registry_factory(paths[default_name])
+    batcher = batcher_factory(registry)
     store = None
     if featurestore_mb > 0:
         from xgboost_tpu.serving.featurestore import FeatureStore
         store = FeatureStore(registry.engine.num_feature,
                              budget_mb=featurestore_mb)
+    cat = ModelCatalog(budget_mb=catalog_mb,
+                       hysteresis_sec=catalog_hysteresis_sec,
+                       default=default_name,
+                       registry_factory=registry_factory,
+                       batcher_factory=batcher_factory)
+    cat.add_model(default_name, paths[default_name], registry=registry,
+                  batcher=batcher, featurestore_mb=featurestore_mb)
+    for name, path in paths.items():
+        if name != default_name:
+            cat.add_model(name, path, featurestore_mb=featurestore_mb)
+    if warmup:
+        # admit the whole manifest up front — compiles land at startup,
+        # not on first traffic; past the budget the LRU tail re-evicts
+        # once it ages out of the hysteresis window
+        for name in cat.names():
+            if name != default_name:
+                try:
+                    cat.resolve(name)
+                except Exception as e:
+                    print(f"[serving] WARNING: model {name!r} failed to "
+                          f"warm: {e} (will retry on first request)",
+                          file=sys.stderr)
     server = PredictServer(registry, batcher, metrics, host=host, port=port,
                            quiet=quiet, drain_grace=drain_sec,
-                           max_body_mb=max_body_mb, featurestore=store)
+                           max_body_mb=max_body_mb, featurestore=store,
+                           catalog=cat)
     if router_url:
         server.attach_fleet(router_url, replica_id=replica_id or None,
                             advertise_url=advertise_url)
     if not quiet:
         eng = registry.engine
-        print(f"[serving] model {model_path} (v{registry.version}, "
+        print(f"[serving] model {paths[default_name]} "
+              f"(v{registry.version}, "
               f"{eng.gbtree.num_trees} trees, {eng.num_feature} features) "
               f"on http://{server.host}:{server.port} — buckets "
-              f"{eng.buckets}", file=sys.stderr)
+              f"{eng.buckets}"
+              + (f"; catalog of {len(cat)} "
+                 f"(default {default_name!r})" if len(cat) > 1 else ""),
+              file=sys.stderr)
     if block:
         server.serve_forever()
         return None

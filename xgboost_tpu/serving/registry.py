@@ -171,6 +171,14 @@ class ModelRegistry:
         d["engine"] = engine.describe()
         return d
 
+    def device_bytes(self) -> int:
+        """Device bytes pinned by the LIVE engine plus the warm
+        rollback ring — the unit the model catalog's shared budget
+        accounts (catalog/catalog.py)."""
+        with self._swap_lock:
+            engines = [self._engine] + [e for _, e, _ in self._previous]
+        return sum(e.device_bytes() for e in engines if e is not None)
+
     def predict(self, X, output_margin: bool = False):
         """Predict on whatever model is current when the call starts
         (the batcher's per-batch engine resolution); the result is
