@@ -343,6 +343,31 @@ def test_file_datasource_cycle_substitution(tmp_path):
     assert src.next_cycle(2) is None  # no fresh-2 yet: idle
 
 
+def test_file_datasource_holdout_refreshes_on_rewrite(tmp_path):
+    """A producer rewriting the holdout file IN PLACE (same path) must
+    invalidate the cache — the (mtime, size) stamp, not the path, pins
+    staleness.  An unchanged file keeps serving the cached object."""
+    def write_svm(path, seed, n=120):
+        rng = np.random.RandomState(seed)
+        X = rng.rand(n, 4)
+        y = (X[:, 0] > 0.5).astype(int)
+        with open(path, "w") as f:
+            for i in range(n):
+                f.write(f"{y[i]} " + " ".join(
+                    f"{j}:{X[i, j]:.6f}" for j in range(4)) + "\n")
+    write_svm(tmp_path / "holdout.libsvm", 9)
+    src = FileDataSource(str(tmp_path / "fresh-{cycle}.libsvm"),
+                         str(tmp_path / "holdout.libsvm"))
+    h0 = src.holdout_for(0)
+    assert src.holdout_for(1) is h0  # untouched file: cached object
+    # in-place rewrite with fresh bytes (row count changes too, so the
+    # stamp moves even on coarse-mtime filesystems)
+    write_svm(tmp_path / "holdout.libsvm", 10, n=140)
+    h1 = src.holdout_for(2)
+    assert h1 is not h0 and h1.num_row == 140
+    assert src.holdout_for(3) is h1
+
+
 # ----------------------------------------------------------- warm start
 def test_train_init_model_continuation_bit_identical(tmp_path):
     """train(init_model=) appends rounds whose iteration numbering

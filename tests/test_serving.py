@@ -280,6 +280,64 @@ def test_batcher_error_propagates_to_all_callers():
         b.close()
 
 
+def test_batcher_tenant_weighted_round_robin():
+    """A heavy tenant's queued burst no longer serves ahead of a light
+    tenant that arrived later: dequeues interleave by smooth WRR
+    (weight 2 vs 1 → exactly 2:1), and the per-tenant dequeue counter
+    accounts every pop."""
+    gate = threading.Event()
+    order = []
+
+    def predict_fn(X, output_margin=False):
+        gate.wait(5.0)
+        return X[:, 0].copy()
+
+    # max_batch_rows == one request's rows: every dequeue is its own
+    # batch, so the service order IS the dequeue order
+    b = MicroBatcher(predict_fn, max_batch_rows=2, max_wait_ms=1,
+                     max_queue_rows=1000)
+    b.set_tenant_weight("heavy", 2.0)
+    orig = b._next_request
+
+    def spy():
+        req = orig()
+        order.append(req.tenant)
+        return req
+
+    b._next_request = spy
+    try:
+        def worker(tenant):
+            b.submit(np.zeros((2, 3), np.float32), tenant=tenant,
+                     timeout=10)
+
+        warm = threading.Thread(target=worker, args=("warm",))
+        warm.start()
+        time.sleep(0.1)  # worker now blocked in the warm flush
+        ts = [threading.Thread(target=worker, args=("heavy",))
+              for _ in range(6)]
+        for t in ts:
+            t.start()
+        time.sleep(0.1)  # the whole heavy burst queued first...
+        tl = [threading.Thread(target=worker, args=("light",))
+              for _ in range(3)]
+        for t in tl:
+            t.start()
+        time.sleep(0.1)  # ...then the light one, all behind the gate
+        gate.set()
+        for t in [warm] + ts + tl:
+            t.join()
+    finally:
+        b.close()
+    assert order[0] == "warm"
+    # smooth WRR at weights (2, 1): heavy, light, heavy, heavy, ...
+    assert order[1:] == ["heavy", "light", "heavy", "heavy", "light",
+                         "heavy", "heavy", "light", "heavy"]
+    from xgboost_tpu.obs.metrics import tenant_dequeues
+    rendered = tenant_dequeues().render()
+    assert 'xgbtpu_batcher_tenant_dequeues_total{model="heavy"}' in rendered
+    assert 'xgbtpu_batcher_tenant_dequeues_total{model="light"}' in rendered
+
+
 # ------------------------------------------------------------- registry
 def test_hot_reload_swap_and_rollback(model, tmp_path):
     bst_a, X, _, _ = model

@@ -1,0 +1,357 @@
+"""xgboost_tpu.stream — streaming, drift-aware continuous learning.
+
+Acceptance criteria covered here (ISSUE 15; the subprocess SIGKILL
+variant lives in ``tools/chaos_loop.py --stream`` → STREAM_CHAOS.json):
+
+(a) drift score semantics: same-distribution cycles score ≈ 0, an
+    injected shift fires, and fire/clear hysteresis triggers exactly
+    ONE refresh per drift episode;
+(b) streaming source determinism: the first ``next_cycle(k)`` commits
+    a manifest and every later call — including from a brand-new
+    source object over the same directory, after MORE batches arrived
+    — replays identical bytes; backpressure and the state machine;
+(c) sliding-holdout window semantics: ``holdout_for(k)`` is the
+    previous ``holdout_cycles`` cycles' batches, one distinct object
+    per cycle index (the incumbent-score cache keys on identity);
+(d) online cut refresh bit-parity at zero drift: rebinding the SAME
+    cuts leaves model bytes identical, and rebinding refreshed
+    (sketch ∪ live-threshold) cuts moves no decision boundary;
+(e) EMA-FS off-knob bit-identity at K ∈ {1, 16} fused segments, and
+    screened training restricted to the kept feature set;
+(f) the StreamTrainer end-to-end: plans committed per cycle, drift →
+    refresh on real shifted data, and a fresh-workdir replay over the
+    same stream directory publishing the identical model sequence.
+"""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import xgboost_tpu as xgb  # noqa: E402
+from xgboost_tpu.binning import CutMatrix  # noqa: E402
+from xgboost_tpu.learner import Booster  # noqa: E402
+from xgboost_tpu.stream import (FeatureDriftTracker,  # noqa: E402
+                                StreamBacklogFull, StreamDataSource,
+                                live_thresholds_of, propose_refreshed_cuts,
+                                run_stream, summarize_columns)
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.4,
+          "silent": 1}
+
+
+def make_rows(n, f=6, shift=0.0, seed=0):
+    rng = np.random.RandomState(seed)
+    X = (rng.rand(n, f) + shift).astype(np.float32)
+    y = ((X[:, 0] + 0.25 * X[:, 1]) > (0.6 + 1.25 * shift)).astype(
+        np.float32)
+    return X, y
+
+
+# ----------------------------------------------------------------- drift
+def test_drift_score_no_drift_near_zero():
+    t = FeatureDriftTracker(4, threshold=0.25)
+    for c in range(4):
+        X, _ = make_rows(500, f=4, seed=c)
+        t.observe_cycle(summarize_columns(X))
+        st = t.step()
+        assert st["max_score"] < 0.1, st
+        assert not st["fired"] and not st["refresh"]
+
+
+def test_drift_score_shift_fires():
+    t = FeatureDriftTracker(4, threshold=0.25, window=2)
+    for c in range(3):
+        X, _ = make_rows(500, f=4, seed=c)
+        t.observe_cycle(summarize_columns(X))
+        t.step()
+    X, _ = make_rows(500, f=4, shift=3.0, seed=10)
+    t.observe_cycle(summarize_columns(X))
+    st = t.step()
+    assert st["max_score"] > 0.25
+    assert st["fired"] and st["refresh"]
+
+
+def test_drift_hysteresis_one_refresh_per_episode():
+    """Scores oscillating above `clear` after a fire re-trigger
+    NOTHING; a refresh edge only returns after the state cleared."""
+    t = FeatureDriftTracker(2, threshold=0.25, clear=0.1, window=1)
+    X, _ = make_rows(800, f=2, seed=0)
+    t.observe_cycle(summarize_columns(X))
+    t.step()
+    refreshes = 0
+    for c in range(4):  # sustained shift: stays fired, no re-refresh
+        Xs, _ = make_rows(800, f=2, shift=2.0, seed=20 + c)
+        t.observe_cycle(summarize_columns(Xs))
+        st = t.step()
+        assert st["fired"]
+        refreshes += int(st["refresh"])
+    assert refreshes == 1
+    # back to the ORIGINAL distribution -> clears (reference never
+    # rebased in this test), then a second shift refires
+    for c in range(2):
+        Xb, _ = make_rows(800, f=2, seed=40 + c)
+        t.observe_cycle(summarize_columns(Xb))
+        st = t.step()
+    assert not st["fired"]
+    Xs, _ = make_rows(800, f=2, shift=2.0, seed=60)
+    t.observe_cycle(summarize_columns(Xs))
+    st = t.step()
+    assert st["refresh"]
+
+
+def test_drift_tracker_roundtrip():
+    t = FeatureDriftTracker(3, threshold=0.2, clear=0.05, window=2)
+    for c in range(3):
+        X, _ = make_rows(300, f=3, seed=c)
+        t.observe_cycle(summarize_columns(X))
+        t.step()
+    t2 = FeatureDriftTracker.from_arrays(t.to_arrays())
+    assert t2.fired == t.fired and t2.threshold == t.threshold
+    np.testing.assert_allclose(t2.scores(), t.scores())
+
+
+# ---------------------------------------------------------------- source
+def test_stream_source_manifests_deterministic(tmp_path):
+    s = StreamDataSource(str(tmp_path / "st"), min_batches=2,
+                         max_batches=3)
+    for i in range(4):
+        s.push(*make_rows(50, seed=i))
+    out = s.next_cycle(0)
+    assert out is not None
+    d0, _ = out
+    names0 = s.batches_for(0)
+    assert len(names0) == 3  # max_batches bite
+    # more batches arrive; the committed cycle must NOT re-decide
+    for i in range(3):
+        s.push(*make_rows(50, seed=10 + i))
+    assert s.batches_for(0) == names0
+    # a brand-new source over the same dir replays identical bytes
+    s2 = StreamDataSource(str(tmp_path / "st"), min_batches=2,
+                          max_batches=3)
+    X0, y0 = s.read_cycle_arrays(0)
+    X0b, y0b = s2.read_cycle_arrays(0)
+    np.testing.assert_array_equal(X0, X0b)
+    np.testing.assert_array_equal(y0, y0b)
+
+
+def test_stream_source_states_and_backpressure(tmp_path):
+    s = StreamDataSource(str(tmp_path / "st"), min_batches=2,
+                         max_batches=2, catchup_backlog=4, max_backlog=5)
+    assert s.next_cycle(0) is None
+    assert s.state == "idle"
+    s.push(*make_rows(10))
+    assert s.next_cycle(0) is None
+    assert s.state == "collecting"
+    for i in range(4):
+        s.push(*make_rows(10, seed=i + 1))
+    assert s.next_cycle(0) is not None
+    assert s.state == "catch_up"  # 5 unclaimed >= catchup_backlog 4
+    assert s.next_cycle(1) is not None
+    assert s.state == "ready"
+    # backlog now 1 (5 - 2*2); cap is 5 -> 4 more pushes fill it
+    for i in range(4):
+        s.push(*make_rows(10, seed=20 + i))
+    with pytest.raises(StreamBacklogFull):
+        s.push(*make_rows(10, seed=99))
+
+
+def test_stream_sliding_holdout_window(tmp_path):
+    s = StreamDataSource(str(tmp_path / "st"), min_batches=1,
+                         max_batches=1, holdout_cycles=2)
+    for i in range(4):
+        s.push(*make_rows(30, seed=i))
+    for c in range(4):
+        assert s.next_cycle(c) is not None
+    # holdout for cycle 3 = cycles {1, 2}'s batches, in order
+    h3 = s.holdout_for(3)
+    y_expect = np.concatenate([s.read_cycle_arrays(1)[1],
+                               s.read_cycle_arrays(2)[1]])
+    np.testing.assert_array_equal(np.asarray(h3.get_label()), y_expect)
+    # one distinct object per cycle index, memoized within a cycle
+    assert s.holdout_for(3) is h3
+    assert s.holdout_for(2) is not h3
+    # cycle 0 (no history) judges on its own batches
+    h0 = s.holdout_for(0)
+    np.testing.assert_array_equal(np.asarray(h0.get_label()),
+                                  s.read_cycle_arrays(0)[1])
+
+
+# ----------------------------------------------------------- cut refresh
+def _train(params, screen=None, k=4, rounds=6, f=10):
+    rng = np.random.RandomState(0)
+    X = rng.rand(800, f).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0.6).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    b = Booster(dict(params), cache=[d])
+    if screen is not None:
+        b.set_feature_screen(screen)
+    b.update_many(d, 0, rounds, rounds_per_dispatch=k)
+    return b, d, X
+
+
+def test_rebind_identical_cuts_byte_identity():
+    """Zero drift degenerates to rebinding the same cuts: model bytes
+    must not move."""
+    b, d, _ = _train(PARAMS)
+    raw = bytes(b.save_raw())
+    preds = np.asarray(b.predict(d))
+    cuts = b.gbtree.cuts
+    b.rebind_cuts(CutMatrix(np.array(cuts.cut_values),
+                            np.array(cuts.n_cuts)))
+    assert bytes(b.save_raw()) == raw
+    np.testing.assert_array_equal(np.asarray(b.predict(d)), preds)
+
+
+def test_rebind_refreshed_cuts_moves_no_boundary():
+    """Sketch-proposal ∪ live-threshold cuts from a SHIFTED
+    distribution: every prediction bit-matches, and training continues
+    on the new binning."""
+    b, d, X = _train(PARAMS)
+    preds = np.asarray(b.predict(d))
+    cuts = propose_refreshed_cuts(
+        summarize_columns(X * 1.7 + 0.3),
+        live_thresholds_of(b.gbtree, X.shape[1]), 64)
+    b.rebind_cuts(cuts)
+    np.testing.assert_array_equal(np.asarray(b.predict(d)), preds)
+    b.update_many(d, 6, 2, rounds_per_dispatch=2)
+    assert np.isfinite(np.asarray(b.predict(d))).all()
+
+
+def test_rebind_missing_threshold_raises():
+    b, d, X = _train(PARAMS)
+    from xgboost_tpu.binning import compute_cuts
+    # cuts built WITHOUT the live thresholds: rebind must refuse
+    # (loudly) rather than silently move split boundaries
+    alien = compute_cuts(xgb.DMatrix(X * 3.0 + 11.0), max_bin=8)
+    with pytest.raises(ValueError, match="absent from the new cuts"):
+        b.rebind_cuts(alien)
+
+
+# --------------------------------------------------------------- EMA-FS
+@pytest.mark.parametrize("k", [1, 16])
+def test_ema_fs_off_bit_identity(k):
+    """ema_fs=0 (the default) ignores any installed screen: model
+    bytes and predictions bit-match a run without the knob, at fused
+    segment sizes 1 and 16."""
+    b0, d, _ = _train(PARAMS, k=k)
+    b1, _, _ = _train({**PARAMS, "ema_fs": 0.0}, screen=[0, 1, 2], k=k)
+    assert bytes(b0.save_raw()) == bytes(b1.save_raw())
+    np.testing.assert_array_equal(np.asarray(b0.predict(d)),
+                                  np.asarray(b1.predict(d)))
+
+
+def test_ema_fs_on_restricts_working_set():
+    kept = [0, 1, 5]
+    b, d, _ = _train({**PARAMS, "ema_fs": 0.9, "ema_fs_min_features": 2},
+                     screen=kept)
+    used = set()
+    for t in b.gbtree.trees:
+        f = np.asarray(t.feature)
+        used |= set(int(i) for i in f[f >= 0])
+    assert used <= set(kept), used
+    assert np.isfinite(np.asarray(b.predict(d))).all()
+
+
+def test_set_feature_screen_validates():
+    b = Booster(dict(PARAMS))
+    with pytest.raises(ValueError):
+        b.set_feature_screen([])
+    with pytest.raises(ValueError):
+        b.set_feature_screen([-1, 2])
+    b.set_feature_screen([2, 0, 2])
+    assert b._feature_screen == (0, 2)
+    b.set_feature_screen(None)
+    assert b._feature_screen is None
+
+
+# ----------------------------------------------------------- end-to-end
+def _push_cycles(src, n_cycles, batches_per_cycle=2, shift=0.0, seed0=0):
+    for i in range(n_cycles * batches_per_cycle):
+        src.push(*make_rows(120, shift=shift, seed=seed0 + i))
+
+
+def _file_hash(path):
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _gated(workdir):
+    try:
+        with open(os.path.join(workdir, "gated.log")) as f:
+            return [ln.split()[1] for ln in f if len(ln.split()) >= 2]
+    except OSError:
+        return []
+
+
+def test_stream_trainer_end_to_end_with_drift(tmp_path):
+    src = StreamDataSource(str(tmp_path / "st"), min_batches=2,
+                           max_batches=2)
+    _push_cycles(src, 2)
+    publish = str(tmp_path / "model.bin")
+    wd = str(tmp_path / "wd")
+    kw = dict(source=src, rounds_per_cycle=2, max_regression=10.0,
+              sleep_sec=0.0, params=dict(PARAMS), quiet=True)
+    summary = run_stream(publish, workdir=wd, cycles=2, **kw)
+    assert summary["published"] == 2 and summary["errors"] == 0
+    plans = [json.load(open(os.path.join(wd, "plans", "plan-%06d.json"
+                                         % c))) for c in (0, 1)]
+    assert not plans[0]["refresh"] and not plans[1]["refresh"]
+    # shifted stream: the next cycle must fire drift and refresh cuts
+    _push_cycles(src, 1, shift=3.0, seed0=50)
+    summary = run_stream(publish, workdir=wd, cycles=1, **kw)
+    assert summary["published"] == 1 and summary["errors"] == 0
+    plan2 = json.load(open(os.path.join(wd, "plans",
+                                        "plan-000002.json")))
+    assert plan2["fired"] and plan2["refresh"]
+    assert os.path.exists(os.path.join(wd, "plans", "cuts-000002.npz"))
+    # the published model still loads + predicts after the refresh
+    bst = xgb.Booster(model_file=publish)
+    X, _ = make_rows(64, shift=3.0, seed=99)
+    assert np.isfinite(np.asarray(bst.predict(xgb.DMatrix(X)))).all()
+
+
+def test_stream_replay_publishes_identical_sequence(tmp_path):
+    """A FRESH workdir over the same stream directory re-derives the
+    identical gated-model sequence — the manifest determinism the
+    chaos harness's replay check rests on."""
+    src = StreamDataSource(str(tmp_path / "st"), min_batches=1,
+                           max_batches=2)
+    _push_cycles(src, 3)
+    kw = dict(rounds_per_cycle=2, cycles=3, max_regression=10.0,
+              sleep_sec=0.0, params=dict(PARAMS), quiet=True)
+    run_stream(str(tmp_path / "a.bin"), workdir=str(tmp_path / "wa"),
+               source=src, **kw)
+    src_b = StreamDataSource(str(tmp_path / "st"), min_batches=1,
+                             max_batches=2)
+    run_stream(str(tmp_path / "b.bin"), workdir=str(tmp_path / "wb"),
+               source=src_b, **kw)
+    a, b = _gated(str(tmp_path / "wa")), _gated(str(tmp_path / "wb"))
+    assert a and a == b
+    assert _file_hash(str(tmp_path / "a.bin")) == _file_hash(
+        str(tmp_path / "b.bin"))
+
+
+def test_stream_ema_fs_screen_applies_across_cycles(tmp_path):
+    """With ema_fs on, cycles after the first train against a reduced
+    feature working set (the EMA needs one cycle of gains first)."""
+    src = StreamDataSource(str(tmp_path / "st"), min_batches=1,
+                           max_batches=1)
+    _push_cycles(src, 3, batches_per_cycle=1)
+    params = {**PARAMS, "ema_fs": 0.8, "ema_fs_min_features": 2}
+    wd = str(tmp_path / "wd")
+    summary = run_stream(str(tmp_path / "m.bin"), workdir=wd,
+                         source=src, rounds_per_cycle=2, cycles=3,
+                         max_regression=10.0, sleep_sec=0.0,
+                         params=params, quiet=True)
+    assert summary["published"] == 3 and summary["errors"] == 0
+    plans = [json.load(open(os.path.join(wd, "plans",
+                                         "plan-%06d.json" % c)))
+             for c in range(3)]
+    assert plans[0]["kept"] is None  # no gain history yet
+    kept = plans[2]["kept"]
+    assert kept is not None and 2 <= len(kept) < 6

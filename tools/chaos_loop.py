@@ -55,6 +55,21 @@ TENANT (each tenant against its OWN ``gated.log``), plus isolation:
 killing one tenant's trainer never stalls the other's lane.  Emits
 ``CATALOG_CHAOS.json``.
 
+``--stream`` switches to the STREAMING chaos mode (PIPELINE.md
+streaming section): a producer thread spools row batches into a
+``StreamDataSource`` directory (shifting the feature distribution
+halfway through, so drift fires and an online cut refresh lands
+mid-chaos) while ``task=stream`` subprocesses consume micro-cycles —
+and the driver SIGKILLs the stream trainer at random moments
+(mid-compose, mid-train, mid-gate, mid-publish).  SIGKILL-only: the
+stream contract under test is replay determinism, not media faults.
+A watcher hashes the publish path continuously; asserted are (a) the
+zero-ungated invariant — every observed publish-path hash is the seed
+or in ``gated.log`` — and (b) bit-identical replay: a FRESH workdir
+consuming the SAME spool re-publishes the identical per-cycle hash
+sequence and identical final model bytes.  Emits
+``STREAM_CHAOS.json``.
+
 ``--train`` switches to the STALL-failure training mode (RELIABILITY.md
 stall matrix): each run arms a ``stall`` mock coordinate (the hang twin
 of worker death, parallel/mock.py) — and, half the time, a death
@@ -634,6 +649,281 @@ def pipeline_mode(args) -> int:
     return 0 if ok else 1
 
 
+def stream_mode(args) -> int:
+    """Streaming chaos: SIGKILL ``task=stream`` trainers mid-micro-
+    cycle while a producer keeps the spool moving and the feature
+    distribution shifts mid-run (see module docstring).  SIGKILL-only
+    — the stream contract under test is replay determinism.
+    Contracts: zero ungated publish-path hashes, and a fresh-workdir
+    replay over the same spool is bit-identical."""
+    import hashlib
+    import subprocess
+    import threading
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import xgboost_tpu as xgb
+    from xgboost_tpu.stream import StreamBacklogFull, StreamDataSource
+
+    work = args.workdir or tempfile.mkdtemp(prefix="xgbtpu_chaosstream_")
+    os.makedirs(work, exist_ok=True)
+    rng = np.random.RandomState(args.seed)
+    cycles = args.stream_cycles
+    stream_dir = os.path.join(work, "stream-in")
+    # bit-identity across the chaos run and the fresh-workdir replay
+    # requires IDENTICAL command strings: the CLI cascades every param
+    # into the learner (reference xgboost_main.cpp behavior) and the
+    # model header serializes the param dict, so a differing
+    # stream_workdir= path would differ the published bytes.  Each run
+    # therefore gets its own cwd holding relative wd/ + published.model
+    # and a symlink to the one shared spool.
+    run_chaos = os.path.join(work, "run-chaos")
+    run_replay = os.path.join(work, "run-replay")
+    os.makedirs(stream_dir, exist_ok=True)
+    for d in (run_chaos, run_replay):
+        os.makedirs(d, exist_ok=True)
+        link = os.path.join(d, "stream-in")
+        if not os.path.lexists(link):
+            os.symlink(os.path.join("..", "stream-in"), link)
+    wd = os.path.join(run_chaos, "wd")
+    publish = os.path.join(run_chaos, "published.model")
+
+    # seed incumbent at the publish path — the warm-start lineage the
+    # replay later reproduces from the same bytes
+    X0 = np.random.RandomState(7).rand(400, 6).astype(np.float32)
+    y0 = (X0[:, 0] + 0.25 * X0[:, 1] > 0.6).astype(np.float32)
+    xgb.train({"objective": "binary:logistic", "max_depth": 3,
+               "eta": 0.4, "silent": 1},
+              xgb.DMatrix(X0, label=y0), 3).save_model(publish)
+    with open(publish, "rb") as f:
+        seed_bytes = f.read()
+    initial_hash = hashlib.sha256(seed_bytes).hexdigest()
+
+    stop = threading.Event()
+    pushed = [0]
+
+    def producer():
+        # batch CONTENT is deterministic (seeded by the producer's own
+        # counter); batch→cycle composition is timing-dependent, which
+        # is the point — the manifests pin it for replay.  The
+        # distribution shifts a third of the way in so drift fires and
+        # a cut refresh lands under chaos.
+        src = StreamDataSource(stream_dir)
+        i = 0
+        while not stop.is_set() and i < 400:
+            r = np.random.RandomState(1000 + i)
+            shift = 0.35 if i >= 6 else 0.0
+            X = (r.rand(160, 6) + shift).astype(np.float32)
+            y = (X[:, 0] + 0.25 * X[:, 1]
+                 > 0.6 + 1.25 * shift).astype(np.float32)
+            try:
+                src.push(X, y)
+            except StreamBacklogFull:
+                time.sleep(0.5)
+                continue
+            i += 1
+            pushed[0] = i
+            time.sleep(0.15)
+
+    observed = set()
+
+    def watcher():
+        # the contract's witness: every complete byte-state the publish
+        # path ever holds (atomic_write => never a torn file)
+        while not stop.is_set():
+            try:
+                with open(publish, "rb") as f:
+                    observed.add(hashlib.sha256(f.read()).hexdigest())
+            except OSError:
+                pass
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=producer),
+               threading.Thread(target=watcher)]
+    for t in threads:
+        t.start()
+
+    def cursor(d=None) -> int:
+        try:
+            with open(os.path.join(d or wd, "state.json")) as f:
+                return int(json.load(f).get("cycle", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def cmd():
+        # relative paths, and the SAME string every attempt (chaos and
+        # replay): the CLI cascades every param into the learner and
+        # the model header records the param dict, so a per-attempt
+        # stream_cycles=remaining would make otherwise-identical
+        # models hash differently.  The driver, not the arg, decides
+        # when a run is done — it SIGKILLs the trainer once the cycle
+        # cursor reaches the target.
+        return [
+            sys.executable, "-m", "xgboost_tpu", "task=stream",
+            "stream_publish_path=published.model", "stream_workdir=wd",
+            "stream_dir=stream-in", f"stream_cycles={cycles}",
+            "stream_rounds_per_cycle=3", "stream_min_batches=1",
+            "stream_max_batches=2", "stream_max_regression=0.5",
+            "stream_sleep_sec=0.1", "objective=binary:logistic",
+            "max_depth=3", "eta=0.4", "ema_fs=0.9", "silent=1"]
+
+    def ledger(workdir):
+        """(all gated hashes, cycle -> LAST gated hash).  A killed-
+        then-resumed cycle re-gates, so the raw ledger may hold
+        several lines per cycle; the last one is the publish."""
+        all_hashes, last = set(), {}
+        try:
+            with open(os.path.join(workdir, "gated.log")) as f:
+                # a SIGKILL can tear the final line; skip short tails
+                for parts in (line.split() for line in f):
+                    if len(parts) >= 2:
+                        try:
+                            last[int(parts[0])] = parts[1]
+                        except ValueError:
+                            continue
+                        all_hashes.add(parts[1])
+        except OSError:
+            pass
+        return all_hashes, last
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    kills = attempts = 0
+    log = open(os.path.join(work, "stream.log"), "ab")
+    target = cycles  # extended until the kill quota is met
+    try:
+        while (cursor() < target or kills < 3) and attempts < 30:
+            if cursor() >= target:
+                target += 2
+                print(f"[chaos-stream] kill quota unmet, extending "
+                      f"target to {target} cycles", file=sys.stderr)
+            attempts += 1
+            p = subprocess.Popen(cmd(), stdout=log, stderr=log,
+                                 cwd=run_chaos, env=env)
+            # short deadlines until the kill quota is met (startup +
+            # the first cycle run longer than this, so SIGKILLs land
+            # inside live micro-cycle work), generous afterwards
+            lo, hi = (5.0, 12.0) if kills < 3 else (8.0, 25.0)
+            deadline = time.perf_counter() + float(rng.uniform(lo, hi))
+            reached = False
+            while time.perf_counter() < deadline and p.poll() is None:
+                if cursor() >= target:
+                    reached = True
+                    break
+                time.sleep(0.25)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+                if reached:
+                    print(f"[chaos-stream] attempt {attempts} reached "
+                          f"target {target}, stopped", file=sys.stderr)
+                else:
+                    kills += 1
+                    print(f"[chaos-stream] SIGKILL attempt {attempts} "
+                          f"(cursor={cursor()}, pushed={pushed[0]})",
+                          file=sys.stderr)
+            else:
+                print(f"[chaos-stream] attempt {attempts} exited "
+                      f"rc={p.returncode} (cursor={cursor()})",
+                      file=sys.stderr)
+        time.sleep(0.5)  # let the watcher observe the final publish
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        completed = cursor()
+        gated, chaos_last = ledger(wd)
+
+        # bit-identical replay: a FRESH run dir + publish path seeded
+        # with the same incumbent bytes, consuming the SAME spool with
+        # the IDENTICAL command string
+        wd2 = os.path.join(run_replay, "wd")
+        pub2 = os.path.join(run_replay, "published.model")
+        with open(pub2, "wb") as f:
+            f.write(seed_bytes)
+        replay_rc = None
+        if completed > 0:
+            print(f"[chaos-stream] replaying {completed} cycles in a "
+                  "fresh workdir...", file=sys.stderr)
+            guard = 0
+            while cursor(wd2) < completed and guard < 10:
+                guard += 1
+                p = subprocess.Popen(cmd(), stdout=log, stderr=log,
+                                     cwd=run_replay, env=env)
+                t0 = time.perf_counter()
+                while (p.poll() is None
+                       and time.perf_counter() - t0 < 300.0):
+                    if cursor(wd2) >= completed:
+                        break
+                    time.sleep(0.25)
+                if p.poll() is None:
+                    p.kill()
+                p.wait()
+                replay_rc = p.returncode
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        log.close()
+
+    # per-cycle published-candidate hashes, both runs restricted to the
+    # cycles the chaos run completed (either side may have started one
+    # cycle past its stop point — that tail is not part of the
+    # contract)
+    _, replay_last = ledger(wd2)
+    chaos_map = {c: h for c, h in chaos_last.items() if c < completed}
+    replay_map = {c: h for c, h in replay_last.items() if c < completed}
+    seq_identical = bool(chaos_map) and replay_map == chaos_map
+    last_cycle = max(chaos_map) if chaos_map else None
+    final_identical = (last_cycle is not None
+                       and replay_map.get(last_cycle)
+                       == chaos_map[last_cycle])
+
+    drift_fires = refreshes = 0
+    plans_dir = os.path.join(wd, "plans")
+    if os.path.isdir(plans_dir):
+        for fn in sorted(os.listdir(plans_dir)):
+            if fn.startswith("plan-") and fn.endswith(".json"):
+                try:
+                    with open(os.path.join(plans_dir, fn)) as f:
+                        plan = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                drift_fires += bool(plan.get("fired"))
+                refreshes += bool(plan.get("refresh"))
+
+    allowed = gated | {initial_hash}
+    violations = sorted(observed - allowed)
+    report = {
+        "mode": "stream", "cycles": cycles,
+        "cycles_target_final": target,
+        "cycles_completed": completed, "attempts": attempts,
+        "kills": kills, "batches_pushed": pushed[0],
+        "gated_hashes": len(gated),
+        "observed_hashes": len(observed),
+        "published_observed": len(observed & gated),
+        "ungated_or_unverified_observed": len(violations),
+        "violations": violations,
+        "drift_fires": drift_fires, "cut_refreshes": refreshes,
+        "replay_rc": replay_rc,
+        "replay_cycles": cursor(wd2),
+        "replay_gated_sequence_identical": seq_identical,
+        "replay_final_bytes_identical": final_identical,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"[chaos-stream] {completed}/{cycles} cycles, {kills} kills, "
+          f"{len(observed)} hashes observed "
+          f"({len(violations)} VIOLATIONS), {drift_fires} drift fires / "
+          f"{refreshes} cut refreshes, replay identical="
+          f"{seq_identical and final_identical} -> {args.out}",
+          file=sys.stderr)
+    ok = (not violations and completed >= cycles and kills >= 3
+          and seq_identical and final_identical
+          and report["published_observed"] >= 1)
+    return 0 if ok else 1
+
+
 def _free_port() -> int:
     import socket
     s = socket.socket()
@@ -993,6 +1283,15 @@ def main(argv=None) -> int:
     ap.add_argument("--pipe-cycles", type=int, default=4,
                     help="--pipeline/--catalog: cycles each pipeline "
                          "(lane) must complete")
+    ap.add_argument("--stream", action="store_true",
+                    help="streaming mode: SIGKILL task=stream "
+                         "trainers mid-micro-cycle while a producer "
+                         "spools drifting batches; zero-ungated + "
+                         "bit-identical fresh-workdir replay "
+                         "(STREAM_CHAOS.json; see module docstring)")
+    ap.add_argument("--stream-cycles", type=int, default=6,
+                    help="--stream: micro-cycles the trainer must "
+                         "complete")
     ap.add_argument("--catalog", action="store_true",
                     help="multi-tenant catalog mode: two width-"
                          "divergent tenants on a catalog fleet, "
@@ -1002,13 +1301,16 @@ def main(argv=None) -> int:
                          "(CATALOG_CHAOS.json; see module docstring)")
     args = ap.parse_args(argv)
     if args.out is None:
-        args.out = ("CATALOG_CHAOS.json" if args.catalog
+        args.out = ("STREAM_CHAOS.json" if args.stream
+                    else "CATALOG_CHAOS.json" if args.catalog
                     else "PIPELINE_CHAOS.json" if args.pipeline
                     else "CHAOS_fleet_slow.json"
                     if args.fleet and args.slow
                     else "CHAOS_fleet.json" if args.fleet
                     else "TRAIN_CHAOS.json" if args.train
                     else "CHAOS.json")
+    if args.stream:
+        return stream_mode(args)
     if args.catalog:
         return catalog_mode(args)
     if args.pipeline:

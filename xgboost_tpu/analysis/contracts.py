@@ -370,6 +370,7 @@ def _collect_param_table(ctx: FileContext, node, facts: Facts) -> None:
         return
     table = {"SERVE_PARAMS": "serve", "FLEET_PARAMS": "fleet",
              "PIPELINE_PARAMS": "pipeline",
+             "STREAM_PARAMS": "stream",
              "CATALOG_PARAMS": "catalog"}.get(name)
     if table is None or not isinstance(node.value, ast.Dict):
         return
@@ -803,7 +804,8 @@ class ContractEngine:
                 facts.families, key=lambda t: (t[0], t[1], t[3])):
             families.setdefault(fam, label)
         params: Dict[str, List[str]] = {"serve": [], "fleet": [],
-                                        "pipeline": [], "catalog": []}
+                                        "pipeline": [], "catalog": [],
+                                        "stream": []}
         for _, table, key, _ in facts.params:
             if key not in params[table]:
                 params[table].append(key)

@@ -218,7 +218,7 @@ class HostSyncInHotLoop(Rule):
 
     HOT_PATHS = ("models/gbtree.py", "models/updaters.py", "ops/",
                  "serving/engine.py", "serving/featurestore.py",
-                 "fleet/", "pipeline/", "catalog/")
+                 "fleet/", "pipeline/", "catalog/", "stream/")
 
     def applies(self, path: str) -> bool:
         return _path_has(path, self.HOT_PATHS)

@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 from xgboost_tpu.config import (CATALOG_PARAMS, FLEET_PARAMS,
                                 PIPELINE_PARAMS, SERVE_PARAMS,
-                                parse_config_file)
+                                STREAM_PARAMS, parse_config_file)
 
 # process start, for recovery-cost accounting.  perf_counter, not
 # wall-clock: these readings are only ever subtracted (XGT006)
@@ -75,6 +75,9 @@ task=fleet_router parameters:
 
 task=pipeline parameters:
 {pipeline_params}
+
+task=stream parameters (streaming drift-aware continuous learning):
+{stream_params}
 
 catalog parameters (multi-tenant serving, task=serve + task=fleet_router):
 {catalog_params}
@@ -120,6 +123,8 @@ class BoostLearnTask:
         self.fleet_params = {k: v for k, (v, _) in FLEET_PARAMS.items()}
         self.pipeline_params = {k: v
                                 for k, (v, _) in PIPELINE_PARAMS.items()}
+        self.stream_params = {k: v
+                              for k, (v, _) in STREAM_PARAMS.items()}
         self.catalog_params = {k: v
                                for k, (v, _) in CATALOG_PARAMS.items()}
 
@@ -198,6 +203,8 @@ class BoostLearnTask:
             self.fleet_params[name] = type(FLEET_PARAMS[name][0])(val)
         elif name in self.pipeline_params:
             self.pipeline_params[name] = type(PIPELINE_PARAMS[name][0])(val)
+        elif name in self.stream_params:
+            self.stream_params[name] = type(STREAM_PARAMS[name][0])(val)
         elif name in self.catalog_params:
             self.catalog_params[name] = type(CATALOG_PARAMS[name][0])(val)
         else:
@@ -216,10 +223,12 @@ class BoostLearnTask:
             from xgboost_tpu.config import (catalog_params_help,
                                             fleet_params_help,
                                             pipeline_params_help,
-                                            serve_params_help)
+                                            serve_params_help,
+                                            stream_params_help)
             print(_USAGE.format(serve_params=serve_params_help(),
                                 fleet_params=fleet_params_help(),
                                 pipeline_params=pipeline_params_help(),
+                                stream_params=stream_params_help(),
                                 catalog_params=catalog_params_help()))
             return 0
         if os.path.exists(argv[0]) or "=" not in argv[0]:
@@ -362,6 +371,8 @@ class BoostLearnTask:
             return self.task_fleet_router()
         if self.task == "pipeline":
             return self.task_pipeline()
+        if self.task == "stream":
+            return self.task_stream()
         raise ValueError(f"unknown task {self.task!r}")
 
     # ------------------------------------------------------------- helpers
@@ -666,6 +677,43 @@ class BoostLearnTask:
             quiet=self.silent != 0)
         if self.silent < 2:
             print(f"[pipeline] done: {summary}", file=sys.stderr)
+        return 0 if summary.get("errors", 0) == 0 else 1
+
+    # ------------------------------------------------------------- stream
+    def task_stream(self) -> int:
+        """Run the streaming drift-aware loop (xgboost_tpu.stream,
+        PIPELINE.md streaming section): consume row batches from the
+        ``stream_dir`` spool as micro-cycles, track per-feature drift,
+        refresh cuts online, and publish gated candidates.  Learner
+        hyperparameters (objective, ema_fs, ...) pass through like
+        ``task=train``."""
+        from xgboost_tpu.stream import run_stream
+        sp = self.stream_params
+        summary = run_stream(
+            sp["stream_publish_path"],
+            workdir=sp["stream_workdir"],
+            stream_dir=sp["stream_dir"],
+            rounds_per_cycle=sp["stream_rounds_per_cycle"],
+            cycles=sp["stream_cycles"],
+            min_batches=sp["stream_min_batches"],
+            max_batches=sp["stream_max_batches"],
+            catchup_backlog=sp["stream_catchup_backlog"],
+            max_backlog=sp["stream_max_backlog"],
+            holdout_cycles=sp["stream_holdout_cycles"],
+            metric=sp["stream_metric"],
+            min_delta=sp["stream_min_delta"],
+            max_regression=sp["stream_max_regression"],
+            router_url=sp["stream_router_url"],
+            sleep_sec=sp["stream_sleep_sec"],
+            drift_threshold=sp["stream_drift_threshold"],
+            drift_clear=sp["stream_drift_clear"],
+            drift_window=sp["stream_drift_window"],
+            sketch_size=sp["stream_sketch_size"],
+            params=self._params_dict(),
+            quiet=self.silent != 0,
+            lane=sp["stream_lane"])
+        if self.silent < 2:
+            print(f"[stream] done: {summary}", file=sys.stderr)
         return 0 if summary.get("errors", 0) == 0 else 1
 
     # -------------------------------------------------------------- dump

@@ -102,6 +102,18 @@ class TrainParam:
     # -1 auto = align to 32 when the pallas kernel is active; 0 = keep
     # every proposed cut (exact sketch resolution)
     hist_bin_align: int = -1
+    # EMA-gain feature screening (xgboost_tpu.stream, PIPELINE.md):
+    # fraction of the per-feature EMA split-gain mass the fused
+    # histogram build must keep — the trainer restricts its (C, N, F)
+    # working set to the smallest feature prefix covering it.  0 (and
+    # >= 1) disables screening; the off path is bit-identical to not
+    # having the knob.  Only the streaming trainer maintains the EMA;
+    # embedders can drive Booster.set_feature_screen directly.
+    ema_fs: float = 0.0
+    # EMA decay per micro-cycle for the per-feature gain shares
+    ema_fs_decay: float = 0.9
+    # screening floor: never screen below this many surviving features
+    ema_fs_min_features: int = 8
     # gblinear coordinate-descent block size: 1 = exact sequential CD
     # (convergent under feature correlation); >1 = shotgun-style parallel
     # updates within each block (reference gblinear-inl.hpp:76-105)
@@ -431,6 +443,77 @@ def pipeline_params_help() -> str:
     """One line per task=pipeline parameter, for CLI usage text."""
     return "\n".join(f"  {name:<26} {help_} (default {default!r})"
                      for name, (default, help_) in PIPELINE_PARAMS.items())
+
+
+# --------------------------------------------------------------- stream
+# task=stream parameters (xgboost_tpu.stream, PIPELINE.md streaming
+# section) — same single-table discipline as PIPELINE_PARAMS: the
+# classic CLI derives its surface from this dict, xgtpu-lint XGT010
+# enforces that every key is consumed outside config.py, and the
+# inventory rides ANALYSIS_CONTRACTS.json.
+STREAM_PARAMS: Dict[str, Tuple[Any, str]] = {
+    "stream_publish_path": ("", "model file the serving tier polls; "
+                                "each gated candidate is atomically "
+                                "published here (REQUIRED; also the "
+                                "warm-start incumbent)"),
+    "stream_workdir": ("./stream", "stream working directory: cycle "
+                                   "state, checkpoint ring, quarantine, "
+                                   "gated-hash ledger, per-cycle drift "
+                                   "plans/sketches"),
+    "stream_dir": ("", "spool directory producers drop batch-*.npz row "
+                       "batches into; micro-cycle manifests commit "
+                       "under it (REQUIRED)"),
+    "stream_rounds_per_cycle": (5, "boosting rounds appended to the "
+                                   "incumbent per micro-cycle"),
+    "stream_cycles": (1, "micro-cycles to run before exiting (0 = run "
+                         "forever)"),
+    "stream_min_batches": (1, "batches that must arrive before a "
+                              "micro-cycle composes (fewer = idle/"
+                              "collecting)"),
+    "stream_max_batches": (8, "most batches one micro-cycle claims "
+                              "(bounds cycle latency under backlog)"),
+    "stream_catchup_backlog": (16, "unclaimed-batch backlog at which "
+                                   "the source reports catch_up state"),
+    "stream_max_backlog": (256, "unclaimed-batch cap: past it push() "
+                                "raises StreamBacklogFull "
+                                "(backpressure)"),
+    "stream_holdout_cycles": (4, "sliding-holdout window: the gate "
+                                 "judges on the previous N cycles' "
+                                 "batches"),
+    "stream_metric": ("", "gate metric name (empty = the objective's "
+                          "default metric)"),
+    "stream_min_delta": (0.0, "gate: minimum improvement over the "
+                              "incumbent required to publish"),
+    "stream_max_regression": (0.0, "gate: tolerated worsening vs the "
+                                   "incumbent when stream_min_delta "
+                                   "<= 0 (drift allowance)"),
+    "stream_router_url": ("", "fleet router base URL: publish through "
+                              "the canary rollout lane (empty = direct "
+                              "atomic swap)"),
+    "stream_sleep_sec": (0.05, "pause between cycles and after an idle "
+                               "poll with no fresh batches"),
+    "stream_drift_threshold": (0.25, "per-feature PSI at which drift "
+                                     "FIRES (triggers one online cut "
+                                     "refresh on the rising edge)"),
+    "stream_drift_clear": (0.1, "PSI below which a fired drift state "
+                                "clears (hysteresis: no refresh storm "
+                                "while scores oscillate)"),
+    "stream_drift_window": (4, "sliding window of per-cycle sketches "
+                               "the drift score compares against the "
+                               "reference"),
+    "stream_sketch_size": (256, "pruned quantile-summary size per "
+                                "feature for drift tracking and online "
+                                "cut proposal"),
+    "stream_lane": ("", "tenant lane name: tags events/log lines and "
+                        "scopes router publishes to that model's "
+                        "replicas"),
+}
+
+
+def stream_params_help() -> str:
+    """One line per task=stream parameter, for CLI usage text."""
+    return "\n".join(f"  {name:<26} {help_} (default {default!r})"
+                     for name, (default, help_) in STREAM_PARAMS.items())
 
 
 # -------------------------------------------------------------- catalog

@@ -98,6 +98,10 @@ class Booster:
         self._model_gen = 0
         self._mesh = None                  # resolved at _lazy_init (dsplit=row)
         self._col_mesh = None              # resolved at _lazy_init (dsplit=col)
+        # EMA-FS feature screen (set_feature_screen): ascending FULL-
+        # space feature ids fused training restricts its histogram
+        # working set to; None = off (the bit-identical default path)
+        self._feature_screen = None
         self._pending_cache = list(cache)  # bound at _lazy_init (needs cuts)
         if model_file is not None:
             self.load_model(model_file)
@@ -133,6 +137,40 @@ class Booster:
                                                self.gbtree.cuts.max_bin)
             # updater / sketch params may have changed the split finder
             self.gbtree._split_finder_cache = None
+
+    def set_feature_screen(self, kept=None) -> None:
+        """Restrict FUSED training's histogram working set to ``kept``
+        full-space feature ids (EMA-FS, ``ema_fs`` > 0 — see
+        xgboost_tpu.stream): the (C, N, F) histogram build touches only
+        the surviving columns, and grown trees are remapped back to the
+        full feature space so model bytes, prediction, and eval are
+        screen-free.  ``None`` clears the screen (the default path,
+        bit-identical to a build that never heard of screening).  The
+        screen applies only where it is safe and profitable — single
+        device, in-memory dense entries, fused segments; every other
+        path ignores it."""
+        if kept is None:
+            self._feature_screen = None
+            return
+        ids = sorted({int(i) for i in kept})
+        if not ids or ids[0] < 0:
+            raise ValueError(
+                "feature screen must keep >= 1 valid feature id")
+        self._feature_screen = tuple(ids)
+
+    def rebind_cuts(self, cuts) -> None:
+        """Swap the quantile cut matrix under the live model (online
+        cut refresh — xgboost_tpu.stream): delegates the exact
+        threshold-preserving remap to :meth:`GBTree.rebind_cuts`, then
+        invalidates every cached binned entry/margin exactly like a
+        whole-model load (the ``_load_np`` discipline) — stale bin ids
+        quantized under the old cuts must never feed a gradient."""
+        if self.gbtree is None or self.param.booster == "gblinear":
+            raise ValueError(
+                "rebind_cuts needs an initialized gbtree model")
+        self.gbtree.rebind_cuts(cuts)
+        self._cache.clear()
+        self._model_gen += 1
 
     # ------------------------------------------------------------- init
     def _lazy_init(self, dtrain: DMatrix):
@@ -1026,6 +1064,35 @@ class Booster:
                 self._sync_margin(e)
             espec.append((dmat, name, e, e is entry))
         etransform = self.obj.fused_eval_transform() if espec else None
+        # EMA-FS (ema_fs > 0 + set_feature_screen): fused segments grow
+        # over the screened (C, N, F_kept) working set.  Confined to the
+        # plain single-device dense path — meshes, paged matrices, exact
+        # mode and rank relayouts keep the full feature set (the screen
+        # is a throughput optimization, never a correctness dependency);
+        # grown trees come back remapped to the full space.
+        screen = None
+        if (self.param.ema_fs > 0
+                and self._feature_screen is not None
+                and self._mesh is None
+                and not entry.external
+                and not getattr(self.gbtree, "exact_raw", False)
+                and entry.rank_pad_prep is None
+                and len(self._feature_screen) < int(entry.binned.shape[1])
+                and all(not e.external and e.rank_pad_prep is None
+                        and not getattr(d, "is_sharded", False)
+                        for d, _, e, t in espec if not t)):
+            screen = self._feature_screen
+            kept_dev = jnp.asarray(screen, jnp.int32)
+
+            def _screened(e):
+                # per-entry screened-column cache, keyed on the kept
+                # set: re-gathering (N, F_kept) columns every segment
+                # would cancel the histogram win
+                if getattr(e, "screen_key", None) != screen:
+                    e.screen_binned = jnp.take(e.binned, kept_dev,
+                                               axis=1)
+                    e.screen_key = screen
+                return e.screen_binned
         align = max(0, int(boundary_align))
         done = 0
         while done < n_rounds:
@@ -1037,16 +1104,20 @@ class Booster:
                 # stay O(distinct) -> bounded scan compiles)
                 seg = min(seg, align - first % align)
             margin_f, emargins_f, eouts = self.gbtree.do_boost_fused(
-                entry.binned, entry.margin, entry.info, fgrad(),
+                _screened(entry) if screen is not None else entry.binned,
+                entry.margin, entry.info, fgrad(),
                 first, seg, row_valid=entry.row_valid, mesh=self._mesh,
-                binned_t=getattr(entry, "binned_t", None),
-                eval_binned=tuple(e.binned for _, _, e, t in espec
-                                  if not t),
+                binned_t=(None if screen is not None
+                          else getattr(entry, "binned_t", None)),
+                eval_binned=tuple(
+                    (_screened(e) if screen is not None else e.binned)
+                    for _, _, e, t in espec if not t),
                 eval_margins=tuple(e.margin for _, _, e, t in espec
                                    if not t),
                 eval_is_train=tuple(t for _, _, _, t in espec),
                 etransform=etransform,
-                rowwise_grad=entry.rank_pad_prep is None)
+                rowwise_grad=entry.rank_pad_prep is None,
+                feature_screen=screen)
             entry.margin = margin_f
             entry.applied = self.gbtree.num_trees
             ei = 0

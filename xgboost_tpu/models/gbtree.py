@@ -288,6 +288,10 @@ class GBTree:
         self.cut_values_dev = jnp.asarray(cuts.cut_values)
         self.n_cuts_dev = jnp.asarray(cuts.n_cuts)
         self._col_pad_cache = None  # (n_shard, cut_values, n_cuts)
+        # (kept_ids, cut_values, n_cuts, kept_dev) of the EMA-FS
+        # feature screen (do_boost_fused feature_screen=); rebuilding
+        # the screened cut arrays every segment would be wasted traffic
+        self._screen_cut_cache = None
         # chunked tree-parallel traversal width (models/tree.py); 0/1 =
         # the sequential scan baseline; -1 auto = 32 on TPU, scan on
         # CPU (the batched compare-select kernel loses to the scan's
@@ -340,6 +344,58 @@ class GBTree:
             else:
                 self._split_finder_cache = False
         return self._split_finder_cache or None
+
+    def rebind_cuts(self, cuts: CutMatrix) -> None:
+        """Swap the quantile cut matrix under the live ensemble — the
+        online cut-refresh seam (xgboost_tpu.stream): every node's
+        ``cut_index`` is re-derived from its RAW ``threshold`` in the
+        new per-feature cut row, so future BINNED training routes rows
+        through the exact same "v < threshold" boundaries while fresh
+        splits draw from drift-tracking cuts.  The swap is EXACT when
+        every live threshold appears in its feature's new row — callers
+        build the new cuts as (sketch proposal ∪ live thresholds,
+        ``stream.drift.propose_refreshed_cuts``); a missing threshold
+        raises ValueError with the model untouched."""
+        cv = np.asarray(cuts.cut_values)
+        nc = np.asarray(cuts.n_cuts)
+        if self.num_trees:
+            stack, group = self._stack(0)
+            feat = np.asarray(stack.feature)          # (T, n_nodes)
+            thr = np.asarray(stack.threshold)
+            ci = np.array(stack.cut_index)
+            m = feat >= 0
+            if m.any():
+                f = feat[m]
+                th = thr[m]
+                if int(f.max()) >= cv.shape[0]:
+                    raise ValueError(
+                        f"rebind_cuts: model splits feature {int(f.max())}"
+                        f" but the new cuts cover only {cv.shape[0]}")
+                rows = cv[f]                          # (M, max_cuts)
+                idx = (rows < th[:, None]).sum(axis=1)
+                at = rows[np.arange(len(f)),
+                          np.minimum(idx, rows.shape[1] - 1)]
+                ok = (idx < nc[f]) & (at == th)
+                if not ok.all():
+                    bad = int(f[~ok][0])
+                    raise ValueError(
+                        f"rebind_cuts: live split threshold "
+                        f"{float(th[~ok][0])!r} of feature {bad} is "
+                        "absent from the new cuts — refreshed cuts must "
+                        "include every live threshold")
+                ci[m] = idx
+            stack = stack._replace(
+                cut_index=jnp.asarray(ci, jnp.int32))
+            T = int(stack.feature.shape[0])
+            self._trees_list = []
+            self._pending = (stack, T)
+            self._stack_cache = (T, stack, group)
+        self.cuts = cuts
+        self.cfg = make_grow_config(self.param, cuts.max_bin)
+        self.cut_values_dev = jnp.asarray(cuts.cut_values)
+        self.n_cuts_dev = jnp.asarray(cuts.n_cuts)
+        self._col_pad_cache = None
+        self._screen_cut_cache = None
 
     def _comm_bytes(self, n_feat: int, mesh=None) -> float:
         """Logical HISTOGRAM-allreduce payload estimate per tree-growth
@@ -630,7 +686,7 @@ class GBTree:
                        row_valid=None, mesh=None, binned_t=None,
                        eval_binned=(), eval_margins=(),
                        eval_is_train=(), etransform=None, donate=None,
-                       rowwise_grad: bool = True):
+                       rowwise_grad: bool = True, feature_screen=None):
         """Scan ``n_rounds`` whole boosting rounds in ONE device launch.
 
         Per-round host dispatch (gradient launch + growth launch + margin
@@ -675,6 +731,13 @@ class GBTree:
           donate: donate the margin/eval-margin carries to XLA (None =
             auto: on for non-CPU backends, where donation is honored;
             env XGBTPU_FUSED_DONATE=0/1 overrides).
+          feature_screen: optional ascending FULL-space feature ids the
+            caller screened ``binned``/``eval_binned`` down to (EMA-FS,
+            xgboost_tpu.stream): the scan grows trees over the screened
+            (C, N, F_kept) working set using matching screened cut
+            arrays, and grown trees' feature ids are remapped back to
+            the full space before they join the ensemble — model bytes
+            and prediction never see the screen.
 
         Returns ``(final margin (N, K), final eval margins tuple,
         stacked per-round transformed eval outputs tuple)``; grown
@@ -706,6 +769,17 @@ class GBTree:
         # compute and belongs to xgbtpu_train_dispatch_seconds alone.
         from xgboost_tpu.obs import span, training_metrics
         from xgboost_tpu.parallel import mock
+        cut_vals, cut_ns = self.cut_values_dev, self.n_cuts_dev
+        kept_dev = None
+        if feature_screen is not None:
+            kept = tuple(int(i) for i in feature_screen)
+            cache = self._screen_cut_cache
+            if cache is None or cache[0] != kept:
+                kidx = jnp.asarray(kept, jnp.int32)
+                cache = (kept, jnp.take(self.cut_values_dev, kidx, axis=0),
+                         jnp.take(self.n_cuts_dev, kidx), kidx)
+                self._screen_cut_cache = cache
+            _, cut_vals, cut_ns, kept_dev = cache
         comm_nbytes = self._comm_bytes(binned.shape[1], mesh)
         for r in range(n_rounds):
             mock.begin_round(first_iteration + r)
@@ -727,8 +801,8 @@ class GBTree:
             margin_f, emargins_f, stacks, eouts = scan(
                 binned, margin, label, weight,
                 jax.random.PRNGKey(self.param.seed),
-                jnp.int32(first_iteration), self.cut_values_dev,
-                self.n_cuts_dev, row_valid, binned_t,
+                jnp.int32(first_iteration), cut_vals,
+                cut_ns, row_valid, binned_t,
                 tuple(eval_binned), tuple(eval_margins),
                 n_rounds=n_rounds, K=K, npar=npar, cfg=self.cfg,
                 split_finder=self._split_finder(), grad_fn=grad_fn,
@@ -747,6 +821,17 @@ class GBTree:
         # scan's own output instead of re-stacking T per-tree slices
         flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]),
                             stacks)
+        if kept_dev is not None:
+            # grown trees speak the SCREENED feature space; remap split
+            # ids back to the full space before anything concatenates,
+            # persists or predicts (thresholds/cut indices already match
+            # the full space: screened rows are whole full-space rows)
+            f = flat.feature
+            flat = flat._replace(feature=jnp.where(
+                f >= 0,
+                jnp.take(kept_dev,
+                         jnp.clip(f, 0, kept_dev.shape[0] - 1)),
+                f))
         group_new = [j // npar for _ in range(n_rounds)
                      for j in range(K * npar)]
         if self.num_trees:

@@ -727,6 +727,103 @@ def pipeline_metrics() -> PipelineMetrics:
     return _PIPELINE
 
 
+class StreamMetrics:
+    """Streaming continuous-learning accounting (``xgbtpu_stream_*``,
+    PIPELINE.md streaming section): batch ingest, micro-cycle
+    composition, the idle/collecting/ready/catch-up state machine,
+    backpressure, and the drift→cut-refresh loop.  One instance per
+    process (:func:`stream_metrics`); rendered into every /metrics
+    body via the registry."""
+
+    def __init__(self, prefix: str = "xgbtpu_stream"):
+        p = prefix
+        self.batches = Counter(
+            f"{p}_batches_total",
+            "spooled row batches claimed into micro-cycle manifests")
+        self.rows = Counter(
+            f"{p}_rows_total", "rows consumed across all micro-cycles")
+        self.cycles = Counter(
+            f"{p}_cycles_total",
+            "micro-cycle manifests composed (each commits its batch "
+            "set before any data is returned)")
+        self.backlog = Gauge(
+            f"{p}_backlog",
+            "unclaimed spooled batches ahead of the consumer")
+        self.backpressure = Counter(
+            f"{p}_backpressure_total",
+            "producer pushes refused because the unclaimed backlog hit "
+            "max_backlog (StreamBacklogFull)")
+        self.state = Gauge(
+            f"{p}_state",
+            "stream source state: 0=idle 1=collecting 2=ready "
+            "3=catch_up")
+        self.drift_score = Gauge(
+            f"{p}_drift_score",
+            "max per-feature PSI of the sliding window vs the "
+            "reference distribution, as of the last cycle")
+        self.drift_events = Counter(
+            f"{p}_drift_events_total",
+            "drift FIRE edges (a score crossing the threshold while "
+            "not already fired; hysteresis suppresses repeats)")
+        self.cut_refreshes = Counter(
+            f"{p}_cut_refreshes_total",
+            "online quantile-cut rebuilds (sketch proposal unioned "
+            "with live thresholds, incumbent rebound exactly)")
+        self.refresh_seconds = Histogram(
+            f"{p}_refresh_seconds",
+            "wall time per online cut refresh (propose + union + "
+            "persist)", _ROUND_BUCKETS)
+        self.kept_features = Gauge(
+            f"{p}_kept_features",
+            "features surviving the EMA-gain screen for the current "
+            "cycle (the histogram working set's F; full width when "
+            "screening is off)")
+        self._all = (self.batches, self.rows, self.cycles, self.backlog,
+                     self.backpressure, self.state, self.drift_score,
+                     self.drift_events, self.cut_refreshes,
+                     self.refresh_seconds, self.kept_features)
+        registry().register("stream", self.render)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._all)
+
+
+_STREAM: Optional[StreamMetrics] = None
+_STREAM_LOCK = threading.Lock()
+
+
+def stream_metrics() -> StreamMetrics:
+    """The process-wide StreamMetrics singleton."""
+    global _STREAM
+    if _STREAM is None:
+        with _STREAM_LOCK:
+            if _STREAM is None:
+                _STREAM = StreamMetrics()
+    return _STREAM
+
+
+_TENANT_DEQUEUES: Optional[LabeledCounter] = None
+_TENANT_DEQUEUES_LOCK = threading.Lock()
+
+
+def tenant_dequeues() -> LabeledCounter:
+    """The process-wide
+    ``xgbtpu_batcher_tenant_dequeues_total{model}`` family: requests
+    dequeued from the micro-batcher's accept queue per tenant — the
+    observable side of weighted round-robin fairness (a heavy tenant's
+    share of dequeues tracks its weight, not its queue depth)."""
+    global _TENANT_DEQUEUES
+    if _TENANT_DEQUEUES is None:
+        with _TENANT_DEQUEUES_LOCK:
+            if _TENANT_DEQUEUES is None:
+                c = LabeledCounter(
+                    "xgbtpu_batcher_tenant_dequeues_total", "model",
+                    "micro-batcher dequeues per tenant (WRR fairness)")
+                registry().register("batcher", c.render)
+                _TENANT_DEQUEUES = c
+    return _TENANT_DEQUEUES
+
+
 # ------------------------------------------------------------------- fleet
 class FleetMetrics:
     """Router-side fleet accounting (``xgbtpu_fleet_*``, SERVING.md

@@ -181,6 +181,12 @@ class ContinuousTrainer:
         self._say(f"publish path failed verification ({cause}); "
                   "restored the last published model from the backup")
 
+    def _prepare_booster(self, bst, cycle: int) -> None:
+        """Per-cycle booster hook, run after any ring resume and before
+        the first boosted round.  The base trainer does nothing; the
+        stream trainer reapplies drift state (cut rebinds, EMA-FS
+        feature screens) that model bytes alone do not carry."""
+
     def _train(self, cycle: int, st: dict) -> Optional[str]:
         """Train the cycle's candidate; returns its path, or None when
         the source has no fresh data yet."""
@@ -212,6 +218,11 @@ class ContinuousTrainer:
                       appended_rounds=appended)
                 self._say(f"cycle {cycle}: resumed mid-train at "
                           f"appended round {appended}")
+        # after the ring resume: ring bytes already carry any refreshed
+        # cuts, but per-cycle state that is NOT serialized in model
+        # bytes (e.g. the stream trainer's feature screen) must be
+        # re-applied here, on fresh runs and resumes alike
+        self._prepare_booster(bst, cycle)
         with span("pipeline.train", cycle=cycle, resumed=appended):
             if appended < self.rounds_per_cycle:
                 # iteration index continues the incumbent's numbering,

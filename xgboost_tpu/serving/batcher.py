@@ -33,7 +33,8 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Callable, List, Optional
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -44,11 +45,15 @@ class QueueFull(RuntimeError):
 
 class _Request:
     __slots__ = ("X", "output_margin", "done", "result", "error", "t0",
-                 "abandoned", "trace_id", "deadline")
+                 "abandoned", "trace_id", "deadline", "tenant")
 
-    def __init__(self, X: np.ndarray, output_margin: bool, deadline=None):
+    def __init__(self, X: np.ndarray, output_margin: bool, deadline=None,
+                 tenant: str = ""):
         self.X = X
         self.output_margin = output_margin
+        # catalog tenant (model name) the request belongs to: the
+        # accept queue dequeues across tenants by weighted round-robin
+        self.tenant = tenant
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -92,7 +97,16 @@ class MicroBatcher:
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue_rows = int(max_queue_rows)
         self.metrics = metrics
-        self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        # the Queue is now only a WAKE-TOKEN channel (one True per
+        # accepted request, None = close sentinel); the requests
+        # themselves wait in per-tenant deques so the worker dequeues
+        # across tenants by smooth weighted round-robin — a heavy
+        # tenant below its quota can no longer queue ahead of a light
+        # one just by arriving first
+        self._q: "queue.Queue[Optional[bool]]" = queue.Queue()
+        self._tenant_q: Dict[str, Deque[_Request]] = {}
+        self._tenant_weights: Dict[str, float] = {}
+        self._wrr_current: Dict[str, float] = {}
         self._queued_rows = 0
         self._lock = threading.Lock()
         self._closed = False
@@ -101,9 +115,19 @@ class MicroBatcher:
         self._worker.start()
 
     # ------------------------------------------------------------- submit
+    def set_tenant_weight(self, tenant: str, weight: float) -> None:
+        """Set a tenant's WRR share (default 1.0; a tenant with weight
+        2 is dequeued twice as often as a weight-1 tenant while both
+        have work queued).  ``weight <= 0`` resets to the default."""
+        with self._lock:
+            if weight <= 0:
+                self._tenant_weights.pop(tenant, None)
+            else:
+                self._tenant_weights[tenant] = float(weight)
+
     def submit(self, X, output_margin: bool = False,
                timeout: Optional[float] = None,
-               deadline=None) -> np.ndarray:
+               deadline=None, tenant: str = "") -> np.ndarray:
         """Enqueue one request and block until its predictions arrive.
 
         Raises :class:`QueueFull` when accepting the rows would exceed
@@ -128,7 +152,7 @@ class MicroBatcher:
             # with the typed error, not a bare TimeoutError race)
             budget = deadline.remaining() + 0.05
             timeout = budget if timeout is None else min(timeout, budget)
-        req = _Request(X, output_margin, deadline=deadline)
+        req = _Request(X, output_margin, deadline=deadline, tenant=tenant)
         with self._lock:
             # closed-check AND enqueue under the same lock as close()'s
             # closed-set: a request can never land BEHIND the close
@@ -151,7 +175,8 @@ class MicroBatcher:
             self._queued_rows += n
             if self.metrics is not None:
                 self.metrics.queue_rows.set(self._queued_rows)
-            self._q.put(req)
+            self._tenant_q.setdefault(tenant, deque()).append(req)
+            self._q.put(True)  # one wake token per accepted request
         if not req.done.wait(timeout):
             # mark-then-raise: the request still sits in the queue, but
             # the worker will skip it at flush time (counted in
@@ -178,13 +203,42 @@ class MicroBatcher:
             if self.metrics is not None:
                 self.metrics.queue_rows.set(self._queued_rows)
 
+    def _next_request(self) -> _Request:
+        """Pop the next request by smooth weighted round-robin across
+        the tenants with queued work.  Called once per consumed wake
+        token, so a non-empty deque is guaranteed."""
+        with self._lock:
+            total = sum(self._weight(t) for t in self._tenant_q)
+            best = None
+            for t in self._tenant_q:
+                c = self._wrr_current.get(t, 0.0) + self._weight(t)
+                self._wrr_current[t] = c
+                if best is None or c > self._wrr_current[best]:
+                    best = t
+            self._wrr_current[best] -= total
+            dq = self._tenant_q[best]
+            req = dq.popleft()
+            if not dq:
+                # drained tenants leave the rotation (and drop their
+                # WRR credit — an idle tenant must not bank priority)
+                del self._tenant_q[best]
+                self._wrr_current.pop(best, None)
+        from xgboost_tpu.obs.metrics import tenant_dequeues
+        tenant_dequeues().inc(best if best else "default")
+        return req
+
+    def _weight(self, tenant: str) -> float:
+        return self._tenant_weights.get(tenant, 1.0)
+
     def _run(self) -> None:
         carry: Optional[_Request] = None
         while True:
-            req = carry if carry is not None else self._q.get()
-            carry = None
-            if req is None:  # close sentinel
-                return
+            if carry is not None:
+                req, carry = carry, None
+            else:
+                if self._q.get() is None:  # close sentinel
+                    return
+                req = self._next_request()
             batch: List[_Request] = [req]
             rows = req.X.shape[0]
             deadline = time.perf_counter() + self.max_wait_ms / 1e3
@@ -193,13 +247,13 @@ class MicroBatcher:
                 if wait <= 0:
                     break
                 try:
-                    nxt = self._q.get(timeout=wait)
+                    tok = self._q.get(timeout=wait)
                 except queue.Empty:
                     break
-                if nxt is None:
-                    carry = None
+                if tok is None:
                     self._q.put(None)  # re-arm the sentinel for after flush
                     break
+                nxt = self._next_request()
                 if (nxt.X.shape[1] != req.X.shape[1]
                         or nxt.output_margin != req.output_margin
                         or rows + nxt.X.shape[0] > self.max_batch_rows):

@@ -436,7 +436,9 @@ class _Handler(BaseHTTPRequestHandler):
         t_submit = time.perf_counter()
         try:
             preds = batcher.submit(X, output_margin=output_margin,
-                                   deadline=dl)
+                                   deadline=dl,
+                                   tenant=(entry.name if entry is not None
+                                           else ""))
         except QueueFull as e:
             _st(503)
             self._send_json(503, {"error": str(e)})

@@ -1,0 +1,87 @@
+"""xgboost_tpu.stream — streaming, drift-aware continuous learning.
+
+Layers four pieces on the continuous-training pipeline (PIPELINE.md
+has the state machine and failure matrix):
+
+- :class:`StreamDataSource` — a directory-spool consumer that turns
+  arriving row batches into deterministic micro-cycles via per-cycle
+  batch manifests (ring resumes and clean replays stay bit-identical),
+  with backpressure (:class:`StreamBacklogFull`) and an
+  idle/collecting/ready/catch-up state machine.
+- Drift detection — :class:`FeatureDriftTracker` scores PSI per
+  feature over sliding sketch summaries; the EvalGate's holdout
+  becomes a sliding window of recent cycles.
+- Online cut refresh — on a drift fire edge, new quantile cuts are
+  proposed from the running sketch and unioned with the incumbent's
+  live thresholds, so ``Booster.rebind_cuts`` re-quantizes without a
+  full pass and without moving any decision boundary.
+- EMA-gain feature screening (``ema_fs=``) — the fused trainer grows
+  over the (C, N, F_kept) working set of the features carrying the
+  recent gain mass; bit-identical to the full build when off.
+
+Quickstart::
+
+    python -m xgboost_tpu task=stream \\
+        stream_publish_path=serving/model.bin stream_dir=./stream-in \\
+        stream_rounds_per_cycle=5 stream_cycles=0 \\
+        objective=binary:logistic max_depth=4 ema_fs=0.95
+"""
+
+from typing import Optional
+
+from xgboost_tpu.pipeline import (EvalGate, Publisher,  # noqa: F401
+                                  RolloutPublisher)
+from xgboost_tpu.stream.drift import (FeatureDriftTracker,  # noqa: F401
+                                      live_thresholds_of,
+                                      propose_refreshed_cuts, psi_score,
+                                      summarize_columns)
+from xgboost_tpu.stream.source import (StreamBacklogFull,  # noqa: F401
+                                       StreamDataSource)
+from xgboost_tpu.stream.trainer import StreamTrainer  # noqa: F401
+
+
+def run_stream(publish_path: str, workdir: str = "./stream",
+               stream_dir: str = "", rounds_per_cycle: int = 5,
+               cycles: int = 1, min_batches: int = 1,
+               max_batches: int = 8, catchup_backlog: int = 16,
+               max_backlog: int = 256, holdout_cycles: int = 4,
+               metric: str = "", min_delta: float = 0.0,
+               max_regression: float = 0.0, router_url: str = "",
+               sleep_sec: float = 0.05, drift_threshold: float = 0.25,
+               drift_clear: float = 0.1, drift_window: int = 4,
+               sketch_size: int = 256,
+               params: Optional[dict] = None,
+               source: Optional[StreamDataSource] = None,
+               quiet: bool = False, lane: str = "") -> dict:
+    """Assemble the streaming loop from flat knob values (the CLI
+    ``task=stream`` surface — every ``STREAM_PARAMS`` key maps to one
+    argument) and run it.  ``source`` overrides the spool seam for
+    embedders (tests, the chaos harness's in-process producers)."""
+    if not publish_path:
+        raise ValueError("stream_publish_path is required")
+    if source is None:
+        if not stream_dir:
+            raise ValueError("stream_dir is required "
+                             "(or pass a StreamDataSource)")
+        source = StreamDataSource(
+            stream_dir, min_batches=min_batches,
+            max_batches=max_batches, catchup_backlog=catchup_backlog,
+            max_backlog=max_backlog, holdout_cycles=holdout_cycles)
+    gate = EvalGate(metric=metric, min_delta=min_delta,
+                    max_regression=max_regression)
+    publisher = (RolloutPublisher(publish_path, router_url, model=lane)
+                 if router_url else Publisher(publish_path))
+    trainer = StreamTrainer(
+        publish_path, source, workdir,
+        rounds_per_cycle=rounds_per_cycle, params=params, gate=gate,
+        publisher=publisher, quiet=quiet, lane=lane,
+        drift_threshold=drift_threshold, drift_clear=drift_clear,
+        drift_window=drift_window, sketch_size=sketch_size)
+    return trainer.run(cycles=cycles, sleep_sec=sleep_sec)
+
+
+__all__ = [
+    "StreamDataSource", "StreamBacklogFull", "StreamTrainer",
+    "FeatureDriftTracker", "run_stream", "psi_score",
+    "propose_refreshed_cuts", "live_thresholds_of", "summarize_columns",
+]

@@ -1,0 +1,253 @@
+"""Per-feature distribution drift tracking over the streaming pipeline.
+
+Built entirely on the weighted quantile sketch (``sketch.py`` — the
+reference's WQSummary semantics): each micro-cycle's batches collapse
+into one bounded :class:`~xgboost_tpu.sketch.QuantileSummary` per
+feature, a sliding window of the last ``window`` cycles forms the
+"current" distribution, and a reference distribution (rebased at every
+cut refresh) anchors the comparison.  The drift score is PSI
+(population stability index) over bucket edges drawn from the
+REFERENCE summary's quantiles — the classic monitoring statistic,
+computed here from sketch rank interpolation instead of raw rows, so
+the tracker's memory is O(features × summary_size) no matter how much
+data streams past.
+
+Hysteresis: the tracker *fires* when any feature's PSI crosses
+``threshold`` and stays fired until every feature drops below
+``clear`` — a score oscillating around the threshold triggers ONE cut
+refresh, not one per cycle (tests/test_stream_drift.py pins this).
+
+Determinism: the whole tracker state round-trips through
+:meth:`FeatureDriftTracker.to_arrays` / :meth:`from_arrays` (plain
+numpy arrays, persisted by the stream trainer's per-cycle plan files),
+so a trainer SIGKILLed mid-cycle rebuilds the identical tracker and
+makes the identical refresh decision on resume.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from xgboost_tpu.sketch import (QuantileSummary, empty_summary,
+                                make_summary, merge_summaries,
+                                propose_cuts, prune_summary)
+
+# PSI bucket proportions are clamped away from zero before the log —
+# an empty bucket is strong evidence, not an infinity
+_PSI_EPS = 1e-4
+
+
+def summarize_columns(X: np.ndarray, max_size: int = 256
+                      ) -> List[QuantileSummary]:
+    """One pruned summary per column of a raw (N, F) batch
+    (NaN = missing, excluded by ``make_summary``)."""
+    X = np.asarray(X)
+    return [prune_summary(make_summary(X[:, f]), max_size)
+            for f in range(X.shape[1])]
+
+
+def merge_column_summaries(a: Sequence[QuantileSummary],
+                           b: Sequence[QuantileSummary],
+                           max_size: int = 256) -> List[QuantileSummary]:
+    """Element-wise merge+prune of two per-feature summary lists."""
+    if not a:
+        return list(b)
+    if not b:
+        return list(a)
+    return [prune_summary(merge_summaries(x, y), max_size)
+            for x, y in zip(a, b)]
+
+
+def summary_cdf(s: QuantileSummary, v: np.ndarray) -> np.ndarray:
+    """Approximate CDF of a summary at values ``v`` via mid-rank
+    interpolation (monotone; exact at summary entries up to the
+    summary's own rank-error bound)."""
+    v = np.asarray(v, dtype=np.float64)
+    if s.size == 0 or s.total_weight <= 0:
+        return np.zeros_like(v)
+    mid = (s.rmin + s.rmax) * 0.5
+    return np.interp(v, s.value, mid) / s.total_weight
+
+
+def psi_score(ref: QuantileSummary, cur: QuantileSummary,
+              n_edges: int = 10) -> float:
+    """PSI of ``cur`` against ``ref`` over ``n_edges`` equal-rank
+    buckets of the reference distribution.  0 = identical; common
+    monitoring folklore reads >0.1 as shifting, >0.25 as shifted."""
+    if ref.size == 0 or cur.size == 0:
+        return 0.0
+    qs = np.arange(1, n_edges) / n_edges
+    mid = (ref.rmin + ref.rmax) * 0.5
+    edges = np.interp(qs * ref.total_weight, mid, ref.value)
+    edges = np.unique(edges)
+    if edges.size == 0:
+        return 0.0
+    p_ref = np.diff(np.concatenate([[0.0], summary_cdf(ref, edges), [1.0]]))
+    p_cur = np.diff(np.concatenate([[0.0], summary_cdf(cur, edges), [1.0]]))
+    p_ref = np.clip(p_ref, _PSI_EPS, None)
+    p_cur = np.clip(p_cur, _PSI_EPS, None)
+    p_ref = p_ref / p_ref.sum()
+    p_cur = p_cur / p_cur.sum()
+    return float(np.sum((p_cur - p_ref) * np.log(p_cur / p_ref)))
+
+
+class FeatureDriftTracker:
+    """Sliding-window per-feature drift scores with fire/clear
+    hysteresis and a running reference sketch for cut proposal."""
+
+    def __init__(self, n_features: int, window: int = 4,
+                 threshold: float = 0.25, clear: float = 0.1,
+                 n_edges: int = 10, max_size: int = 256):
+        self.n_features = int(n_features)
+        self.window = max(1, int(window))
+        self.threshold = float(threshold)
+        self.clear = float(clear)
+        self.n_edges = int(n_edges)
+        self.max_size = int(max_size)
+        self.reference: List[QuantileSummary] = [
+            empty_summary() for _ in range(self.n_features)]
+        # newest-last per-cycle summaries, at most `window` entries
+        self.recent: List[List[QuantileSummary]] = []
+        self.fired = False
+
+    # ----------------------------------------------------------- observe
+    def observe_cycle(self, col_summaries: Sequence[QuantileSummary]
+                      ) -> None:
+        """Fold one micro-cycle's per-feature summaries into the
+        sliding window (and, while the reference is still empty —
+        before the first rebase — into the reference too, so cycle 0
+        scores ≈ 0 against itself instead of against nothing)."""
+        if len(col_summaries) != self.n_features:
+            raise ValueError(
+                f"expected {self.n_features} feature summaries, "
+                f"got {len(col_summaries)}")
+        self.recent.append(list(col_summaries))
+        if len(self.recent) > self.window:
+            self.recent.pop(0)
+        if all(s.size == 0 for s in self.reference):
+            self.reference = merge_column_summaries(
+                self.reference, col_summaries, self.max_size)
+
+    def current(self) -> List[QuantileSummary]:
+        """The sliding window merged into one summary per feature."""
+        acc: List[QuantileSummary] = [
+            empty_summary() for _ in range(self.n_features)]
+        for cycle in self.recent:
+            acc = merge_column_summaries(acc, cycle, self.max_size)
+        return acc
+
+    # ------------------------------------------------------------ scores
+    def scores(self) -> np.ndarray:
+        """(F,) PSI of the current window against the reference."""
+        cur = self.current()
+        return np.asarray(
+            [psi_score(self.reference[f], cur[f], self.n_edges)
+             for f in range(self.n_features)], dtype=np.float64)
+
+    def step(self) -> dict:
+        """Score + hysteresis update for the cycle just observed.
+        Returns ``{scores, max_score, fired, refresh}`` where
+        ``refresh`` is True exactly on the not-fired -> fired edge —
+        the one moment a cut refresh should run."""
+        scores = self.scores()
+        mx = float(scores.max()) if scores.size else 0.0
+        refresh = False
+        if not self.fired and mx >= self.threshold:
+            self.fired = True
+            refresh = True
+        elif self.fired and mx < self.clear:
+            self.fired = False
+        return {"scores": scores, "max_score": mx,
+                "fired": self.fired, "refresh": refresh}
+
+    def rebase(self) -> None:
+        """Adopt the current window as the new reference — called after
+        a cut refresh so the next drift episode measures against the
+        distribution the refreshed cuts were built from."""
+        self.reference = self.current()
+
+    # ------------------------------------------------------ persistence
+    def to_arrays(self) -> Dict[str, np.ndarray]:
+        """Flatten the full tracker state to plain arrays (npz-able)."""
+        out: Dict[str, np.ndarray] = {
+            "meta": np.asarray([self.n_features, self.window,
+                                self.n_edges, self.max_size,
+                                int(self.fired), len(self.recent)],
+                               dtype=np.int64),
+            "thresholds": np.asarray([self.threshold, self.clear],
+                                     dtype=np.float64),
+        }
+
+        def put(prefix: str, s: QuantileSummary, f: int) -> None:
+            out[f"{prefix}{f}_v"] = s.value
+            out[f"{prefix}{f}_rmin"] = s.rmin
+            out[f"{prefix}{f}_rmax"] = s.rmax
+            out[f"{prefix}{f}_wmin"] = s.wmin
+
+        for f, s in enumerate(self.reference):
+            put("ref", s, f)
+        for j, cycle in enumerate(self.recent):
+            for f, s in enumerate(cycle):
+                put(f"w{j}_", s, f)
+        return out
+
+    @classmethod
+    def from_arrays(cls, arrays: Dict[str, np.ndarray]
+                    ) -> "FeatureDriftTracker":
+        meta = np.asarray(arrays["meta"])
+        thr = np.asarray(arrays["thresholds"])
+        self = cls(int(meta[0]), window=int(meta[1]),
+                   threshold=float(thr[0]), clear=float(thr[1]),
+                   n_edges=int(meta[2]), max_size=int(meta[3]))
+        self.fired = bool(meta[4])
+
+        def get(prefix: str, f: int) -> QuantileSummary:
+            return QuantileSummary(
+                np.asarray(arrays[f"{prefix}{f}_v"], np.float64),
+                np.asarray(arrays[f"{prefix}{f}_rmin"], np.float64),
+                np.asarray(arrays[f"{prefix}{f}_rmax"], np.float64),
+                np.asarray(arrays[f"{prefix}{f}_wmin"], np.float64))
+
+        self.reference = [get("ref", f) for f in range(self.n_features)]
+        self.recent = [[get(f"w{j}_", f) for f in range(self.n_features)]
+                       for j in range(int(meta[5]))]
+        return self
+
+
+def propose_refreshed_cuts(summaries: Sequence[QuantileSummary],
+                           live_thresholds: Sequence[np.ndarray],
+                           max_bin: int):
+    """New :class:`~xgboost_tpu.binning.CutMatrix` for an online cut
+    refresh: per feature, the sketch proposal over the CURRENT
+    distribution, unioned with every raw threshold live in the
+    incumbent's trees.  The union makes the swap EXACT — every live
+    split's "v < threshold" boundary survives as a cut, so
+    ``GBTree.rebind_cuts`` remaps old trees without moving a single
+    decision boundary (bit-parity pinned in tests/test_stream.py).
+    The union at most doubles a feature's cut row (live thresholds are
+    a subset of the OLD row, which was itself ``max_bin``-bounded)."""
+    from xgboost_tpu.binning import pack_cuts
+    per_feature = []
+    for f, s in enumerate(summaries):
+        cuts = propose_cuts(s, max_bin - 1)  # leave room for missing bin
+        thr = (np.asarray(live_thresholds[f], np.float32)  # xgtpu: disable=XGT002 — host arrays, once per cut refresh
+               if f < len(live_thresholds) else np.zeros(0, np.float32))
+        per_feature.append(np.unique(np.concatenate(
+            [cuts.astype(np.float32), thr])))
+    return pack_cuts(per_feature)
+
+
+def live_thresholds_of(gbtree, n_features: int) -> List[np.ndarray]:
+    """Per-feature raw split thresholds live in an ensemble (the values
+    a cut refresh must preserve).  Empty lists for an empty model."""
+    acc: List[list] = [[] for _ in range(n_features)]
+    if gbtree is not None:
+        for t in gbtree.trees:
+            f = np.asarray(t.feature)  # xgtpu: disable=XGT002 — tiny per-tree pulls, once per cut refresh
+            thr = np.asarray(t.threshold)  # xgtpu: disable=XGT002 — tiny per-tree pulls, once per cut refresh
+            m = (f >= 0) & (f < n_features)
+            for fi, tv in zip(f[m], thr[m]):
+                acc[int(fi)].append(np.float32(tv))
+    return [np.unique(np.asarray(a, np.float32)) for a in acc]

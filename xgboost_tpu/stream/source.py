@@ -1,0 +1,269 @@
+"""Streaming DataSource: arriving row batches -> deterministic micro-cycles.
+
+The spool directory is the wire format: producers drop one ``.npz``
+per row batch (``push`` writes them atomically — a consumer can never
+read a torn batch), and the consumer side composes micro-cycles from
+whatever has arrived.  The determinism contract of the pipeline's
+:class:`~xgboost_tpu.pipeline.datasource.DataSource` seam ("same
+cycle index -> same bytes, every call") is carried by per-cycle
+**manifests**: the first ``next_cycle(k)`` call commits an atomic
+manifest naming exactly which batch files make up cycle ``k`` BEFORE
+any data is returned, and every later call — a ring resume after a
+SIGKILL mid-train, a crash-recovery re-gate, or a clean replay from a
+fresh workdir over the same stream directory — replays the manifest
+instead of re-deciding.  Batch files are append-only and never
+deleted, so a replay months later still finds its bytes.
+
+State machine (reported via ``state`` + the
+``xgbtpu_stream_state`` gauge):
+
+    idle        no unclaimed batches
+    collecting  some batches, fewer than ``min_batches``
+    ready       >= min_batches; the next cycle takes up to
+                ``max_batches`` of them
+    catch_up    backlog >= ``catchup_backlog``: the consumer is behind;
+                cycles take full ``max_batches`` bites until drained
+
+Backpressure: ``push`` raises :class:`StreamBacklogFull` once
+``max_backlog`` unclaimed batches are spooled — the producer slows
+down instead of the directory growing without bound.
+
+Sliding holdout: ``holdout_for(k)`` is the concatenation of the
+batches of the previous ``holdout_cycles`` manifests — the gate
+judges candidates on RECENT data that the candidate itself did not
+train on (cycle ``k``'s own batches are excluded, except at cycle 0
+where nothing earlier exists), which is what makes the gate
+drift-aware: as the stream moves, so does the window.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from xgboost_tpu.pipeline.datasource import DataSource
+
+_BATCH_RE = re.compile(r"batch-(\d{12})\.npz$")
+_MANIFEST_FMT = "cycle-%06d.json"
+
+
+class StreamBacklogFull(RuntimeError):
+    """``push`` refused: the unclaimed-batch backlog hit the cap."""
+
+
+def _metrics():
+    from xgboost_tpu.obs.metrics import stream_metrics
+    return stream_metrics()
+
+
+class StreamDataSource(DataSource):
+    """Directory-spool streaming feed with per-cycle batch manifests."""
+
+    STATES = ("idle", "collecting", "ready", "catch_up")
+
+    def __init__(self, stream_dir: str, min_batches: int = 1,
+                 max_batches: int = 8, catchup_backlog: int = 16,
+                 max_backlog: int = 256, holdout_cycles: int = 4):
+        self.stream_dir = stream_dir
+        self.spool_dir = os.path.join(stream_dir, "spool")
+        self.manifest_dir = os.path.join(stream_dir, "manifests")
+        self.min_batches = max(1, int(min_batches))
+        self.max_batches = max(self.min_batches, int(max_batches))
+        self.catchup_backlog = max(1, int(catchup_backlog))
+        self.max_backlog = max(1, int(max_backlog))
+        self.holdout_cycles = max(1, int(holdout_cycles))
+        self.state = "idle"
+        self._holdout_memo: Dict[int, object] = {}
+        os.makedirs(self.spool_dir, exist_ok=True)
+        os.makedirs(self.manifest_dir, exist_ok=True)
+
+    # ------------------------------------------------------------ producer
+    def push(self, X: np.ndarray, y: np.ndarray) -> str:
+        """Spool one row batch atomically; returns the batch file name.
+        Raises :class:`StreamBacklogFull` under backpressure."""
+        backlog = self.backlog()
+        if backlog >= self.max_backlog:
+            m = _metrics()
+            m.backpressure.inc()
+            m.backlog.set(float(backlog))
+            raise StreamBacklogFull(
+                f"{self.spool_dir}: {backlog} unclaimed batches "
+                f"(max_backlog={self.max_backlog})")
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float32).ravel()
+        if X.shape[0] != y.shape[0]:
+            raise ValueError(f"X has {X.shape[0]} rows, y has {y.shape[0]}")
+        buf = io.BytesIO()
+        np.savez(buf, X=X, y=y)
+        from xgboost_tpu.reliability.integrity import atomic_write
+        tmp = os.path.join(self.spool_dir,
+                           f".incoming-{os.getpid()}-{id(buf):x}.npz")
+        atomic_write(tmp, buf.getvalue())
+        try:
+            seq = self._max_seq() + 1
+            while True:
+                final = os.path.join(self.spool_dir, f"batch-{seq:012d}.npz")
+                try:
+                    # exclusive claim of the sequence slot: concurrent
+                    # producers race on link(2), never on file content
+                    os.link(tmp, final)
+                    return os.path.basename(final)
+                except FileExistsError:
+                    seq += 1
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError as e:
+                from xgboost_tpu.obs.metrics import swallowed_error
+                swallowed_error("stream.push_tmp", e)
+
+    # ------------------------------------------------------------ geometry
+    def _batches(self) -> List[Tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.spool_dir):
+            m = _BATCH_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), name))
+        out.sort()
+        return out
+
+    def _max_seq(self) -> int:
+        b = self._batches()
+        return b[-1][0] if b else 0
+
+    def _manifest_path(self, cycle: int) -> str:
+        return os.path.join(self.manifest_dir, _MANIFEST_FMT % cycle)
+
+    def _read_manifest(self, cycle: int) -> Optional[dict]:
+        try:
+            with open(self._manifest_path(cycle), encoding="utf-8") as f:
+                m = json.load(f)
+            return m if isinstance(m, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _claimed_through(self, cycle: int) -> int:
+        """Highest batch seq claimed by cycles before ``cycle`` (cycles
+        are contiguous — the trainer never skips an index)."""
+        if cycle <= 0:
+            return 0
+        m = self._read_manifest(cycle - 1)
+        if m is None:
+            raise RuntimeError(
+                f"stream manifest for cycle {cycle - 1} is missing — "
+                f"cycles must be composed in order ({self.manifest_dir})")
+        return int(m["through"])
+
+    def backlog(self, cycle: Optional[int] = None) -> int:
+        """Unclaimed batch count (``cycle`` = next cycle to compose;
+        None = against the newest existing manifest)."""
+        if cycle is None:
+            cycles = self._manifest_cycles()
+            cycle = (cycles[-1] + 1) if cycles else 0
+        through = self._claimed_through(cycle)
+        return sum(1 for seq, _ in self._batches() if seq > through)
+
+    def _manifest_cycles(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.manifest_dir):
+            m = re.match(r"cycle-(\d{6})\.json$", name)
+            if m:
+                out.append(int(m.group(1)))
+        out.sort()
+        return out
+
+    # ------------------------------------------------------------ consumer
+    def _compose(self, cycle: int) -> Optional[dict]:
+        """Commit cycle ``cycle``'s manifest from unclaimed batches, or
+        None when fewer than ``min_batches`` have arrived."""
+        through = self._claimed_through(cycle)
+        unclaimed = [(seq, name) for seq, name in self._batches()
+                     if seq > through]
+        backlog = len(unclaimed)
+        m = _metrics()
+        m.backlog.set(float(backlog))
+        if backlog < self.min_batches:
+            self._set_state("collecting" if backlog else "idle")
+            return None
+        self._set_state("catch_up" if backlog >= self.catchup_backlog
+                        else "ready")
+        take = unclaimed[:self.max_batches]
+        manifest = {"cycle": cycle,
+                    "batches": [name for _, name in take],
+                    "through": take[-1][0]}
+        from xgboost_tpu.reliability.integrity import atomic_write
+        atomic_write(self._manifest_path(cycle),
+                     (json.dumps(manifest, sort_keys=True) + "\n").encode())
+        m.cycles.inc()
+        m.batches.inc(len(take))
+        return manifest
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        _metrics().state.set(float(self.STATES.index(state)))
+
+    def batches_for(self, cycle: int) -> Optional[List[str]]:
+        """The committed batch file names of a cycle, or None before
+        its manifest exists."""
+        m = self._read_manifest(cycle)
+        return None if m is None else list(m["batches"])
+
+    def read_cycle_arrays(self, cycle: int
+                          ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """(X, y) of a cycle's committed batches, concatenated — the
+        raw-row view the drift tracker sketches from."""
+        names = self.batches_for(cycle)
+        if names is None:
+            return None
+        return self._read_batches(names)
+
+    def _read_batches(self, names: List[str]
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        xs, ys = [], []
+        for name in names:
+            with np.load(os.path.join(self.spool_dir, name),
+                         allow_pickle=False) as z:
+                xs.append(np.asarray(z["X"], np.float32))  # xgtpu: disable=XGT002 — host npz read, once per cycle
+                ys.append(np.asarray(z["y"], np.float32))  # xgtpu: disable=XGT002 — host npz read, once per cycle
+        return np.concatenate(xs), np.concatenate(ys)
+
+    def next_cycle(self, cycle: int):
+        manifest = self._read_manifest(cycle)
+        if manifest is None:
+            manifest = self._compose(cycle)
+            if manifest is None:
+                return None
+        X, y = self._read_batches(manifest["batches"])
+        _metrics().rows.inc(len(y))
+        from xgboost_tpu.data import DMatrix
+        return DMatrix(X, label=y), self.holdout_for(cycle)
+
+    def holdout_for(self, cycle: int):
+        """Sliding holdout: the previous ``holdout_cycles`` cycles'
+        batches (cycle 0, with no history, judges on its own batches —
+        the gate passes unconditionally there anyway, cold start)."""
+        if cycle in self._holdout_memo:
+            return self._holdout_memo[cycle]
+        lo = max(0, cycle - self.holdout_cycles)
+        window = list(range(lo, cycle)) if cycle > 0 else [0]
+        names: List[str] = []
+        for c in window:
+            part = self.batches_for(c)
+            if part is None:
+                return None
+            names.extend(part)
+        X, y = self._read_batches(names)
+        from xgboost_tpu.data import DMatrix
+        hold = DMatrix(X, label=y)
+        # one object per cycle index: the trainer's incumbent-score
+        # cache keys on id(holdout), so a NEW window naturally
+        # invalidates it while re-gates within a cycle reuse it
+        self._holdout_memo[cycle] = hold
+        while len(self._holdout_memo) > 4:
+            self._holdout_memo.pop(min(self._holdout_memo))
+        return hold

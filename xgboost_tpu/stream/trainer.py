@@ -1,0 +1,231 @@
+"""StreamTrainer: drift-aware micro-cycles over the pipeline loop.
+
+A :class:`~xgboost_tpu.pipeline.trainer.ContinuousTrainer` subclass
+that plugs the streaming subsystem into the ``_prepare_booster`` seam:
+before every cycle's first boosted round it (1) folds the cycle's raw
+batches into the per-feature drift sketch, (2) on a drift *fire* edge
+rebuilds the quantile cuts online (sketch proposal ∪ live thresholds —
+``GBTree.rebind_cuts`` remaps the incumbent exactly, no decision
+boundary moves), and (3) refreshes the EMA-gain feature screen that
+``ema_fs=`` uses to shrink the histogram working set.
+
+Crash discipline mirrors the base trainer: the per-cycle drift
+decision is committed to a **plan file** (``plans/plan-NNNNNN.json``,
+written atomically AFTER its sketch/cuts artifacts) before any of it
+is applied to the booster.  A trainer SIGKILLed anywhere in the cycle
+re-enters ``_prepare_booster`` on resume, finds the plan, and replays
+the identical decision — the drift tracker is never re-advanced for a
+cycle that already has a plan, so ring resumes stay bit-identical.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from xgboost_tpu.binning import CutMatrix
+from xgboost_tpu.obs.metrics import stream_metrics
+from xgboost_tpu.pipeline.trainer import ContinuousTrainer
+from xgboost_tpu.stream.drift import (FeatureDriftTracker,
+                                      live_thresholds_of,
+                                      propose_refreshed_cuts,
+                                      summarize_columns)
+
+_PLAN_FMT = "plan-%06d.json"
+_SKETCH_FMT = "sketch-%06d.npz"
+_CUTS_FMT = "cuts-%06d.npz"
+
+
+def _save_npz(path: str, arrays: dict) -> None:
+    from xgboost_tpu.reliability.integrity import atomic_write
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    atomic_write(path, buf.getvalue())
+
+
+class StreamTrainer(ContinuousTrainer):
+    """Continuous trainer with per-cycle drift tracking, online cut
+    refresh, and EMA-gain feature screening."""
+
+    def __init__(self, *args, drift_threshold: float = 0.25,
+                 drift_clear: float = 0.1, drift_window: int = 4,
+                 sketch_size: int = 256, **kw):
+        super().__init__(*args, **kw)
+        self.drift_threshold = float(drift_threshold)
+        self.drift_clear = float(drift_clear)
+        self.drift_window = max(1, int(drift_window))
+        self.sketch_size = max(16, int(sketch_size))
+        self.plans_dir = os.path.join(self.workdir, "plans")
+        os.makedirs(self.plans_dir, exist_ok=True)
+        self.stream_metrics = stream_metrics()
+
+    # ------------------------------------------------------------- plans
+    def _plan_path(self, cycle: int) -> str:
+        return os.path.join(self.plans_dir, _PLAN_FMT % cycle)
+
+    def _sketch_path(self, cycle: int) -> str:
+        return os.path.join(self.plans_dir, _SKETCH_FMT % cycle)
+
+    def _cuts_path(self, cycle: int) -> str:
+        return os.path.join(self.plans_dir, _CUTS_FMT % cycle)
+
+    def _read_plan(self, cycle: int) -> Optional[dict]:
+        try:
+            with open(self._plan_path(cycle), encoding="utf-8") as f:
+                p = json.load(f)
+            return p if isinstance(p, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _load_tracker(self, cycle: int, n_features: int):
+        """The tracker + EMA state as of the END of the previous cycle
+        (cycle 0 or missing artifacts start fresh)."""
+        ema = np.zeros(n_features, np.float64)
+        ntrees = 0
+        path = self._sketch_path(cycle - 1)
+        if cycle > 0 and os.path.exists(path):
+            with np.load(path, allow_pickle=False) as z:
+                arrays = {k: z[k] for k in z.files}
+            tracker = FeatureDriftTracker.from_arrays(arrays)
+            if "ema" in arrays and arrays["ema"].shape[0] == n_features:
+                ema = np.asarray(arrays["ema"], np.float64)
+            if "ntrees" in arrays:
+                ntrees = int(arrays["ntrees"])
+        else:
+            tracker = FeatureDriftTracker(
+                n_features, window=self.drift_window,
+                threshold=self.drift_threshold, clear=self.drift_clear,
+                max_size=self.sketch_size)
+        return tracker, ema, ntrees
+
+    # --------------------------------------------------------------- EMA
+    def _ema_update(self, bst, ema: np.ndarray, prev_ntrees: int
+                    ) -> tuple:
+        """Fold the gain mass of the trees appended since the previous
+        cycle into the per-feature EMA.  Returns (ema, ntrees_now)."""
+        decay = float(self.params.get("ema_fs_decay", 0.9))
+        trees = bst.gbtree.trees if bst.gbtree is not None else []
+        n_features = ema.shape[0]
+        if len(trees) > prev_ntrees:
+            g = np.zeros(n_features, np.float64)
+            for t in trees[prev_ntrees:]:
+                f = np.asarray(t.feature)  # xgtpu: disable=XGT002 — tiny per-tree pulls, once per cycle
+                gain = np.asarray(t.gain, np.float64)  # xgtpu: disable=XGT002 — tiny per-tree pulls, once per cycle
+                m = (f >= 0) & (f < n_features)
+                np.add.at(g, f[m], gain[m])
+            total = g.sum()
+            share = g / total if total > 0 else g
+            ema = decay * ema + (1.0 - decay) * share
+        return ema, len(trees)
+
+    def _screen_of(self, ema: np.ndarray) -> Optional[List[int]]:
+        """Smallest EMA-descending feature prefix covering ``ema_fs``
+        of the gain mass (floored at ``ema_fs_min_features``), or None
+        to keep every feature."""
+        frac = float(self.params.get("ema_fs", 0.0))
+        if frac <= 0 or frac >= 1.0:
+            return None
+        total = float(ema.sum())
+        if total <= 0:
+            return None  # no gain signal yet: screen nothing
+        order = np.argsort(-ema, kind="stable")
+        csum = np.cumsum(ema[order]) / total
+        n_keep = int(np.searchsorted(csum, frac) + 1)
+        n_keep = max(n_keep,
+                     int(self.params.get("ema_fs_min_features", 8)))
+        if n_keep >= ema.shape[0]:
+            return None
+        return sorted(int(i) for i in order[:n_keep])
+
+    # ------------------------------------------------------------ prepare
+    def _prepare_booster(self, bst, cycle: int) -> None:
+        plan = self._read_plan(cycle)
+        if plan is None:
+            plan = self._compose_plan(bst, cycle)
+        self._apply_plan(bst, plan)
+
+    def _compose_plan(self, bst, cycle: int) -> dict:
+        """Advance the drift tracker over cycle ``cycle``'s batches and
+        commit the resulting decision.  Runs at most once per cycle —
+        resumes replay the committed plan instead."""
+        X, _ = self.source.read_cycle_arrays(cycle)
+        n_features = int(X.shape[1])
+        tracker, ema, prev_ntrees = self._load_tracker(cycle, n_features)
+        if tracker.n_features != n_features:
+            # stream schema changed: restart drift tracking
+            tracker = FeatureDriftTracker(
+                n_features, window=self.drift_window,
+                threshold=self.drift_threshold, clear=self.drift_clear,
+                max_size=self.sketch_size)
+            ema = np.zeros(n_features, np.float64)
+        tracker.observe_cycle(
+            summarize_columns(X, max_size=self.sketch_size))
+        step = tracker.step()
+        sm = self.stream_metrics
+        sm.drift_score.set(step["max_score"])
+        # refresh only with an incumbent to rebind — a cold-start model
+        # gets fresh cuts from its own quantile pass anyway
+        refresh = bool(step["refresh"]) and bst.gbtree is not None
+        if step["refresh"]:
+            sm.drift_events.inc()
+            self._event("stream.drift", cycle=cycle,
+                        max_score=round(step["max_score"], 6),
+                        refresh=refresh)
+            self._say(f"cycle {cycle}: drift fired "
+                      f"(max PSI {step['max_score']:.4f})")
+        if refresh:
+            t0 = time.monotonic()
+            max_bin = int(self.params.get("max_bin", 256))
+            cuts = propose_refreshed_cuts(
+                tracker.current(),
+                live_thresholds_of(bst.gbtree, n_features), max_bin)
+            _save_npz(self._cuts_path(cycle),
+                      {"cut_values": cuts.cut_values,
+                       "n_cuts": cuts.n_cuts})
+            tracker.rebase()
+            sm.cut_refreshes.inc()
+            sm.refresh_seconds.observe(time.monotonic() - t0)
+            self._event("stream.cut_refresh", cycle=cycle,
+                        max_cuts=int(cuts.cut_values.shape[1]))
+        ema, ntrees = self._ema_update(bst, ema, prev_ntrees)
+        kept = self._screen_of(ema)
+        arrays = tracker.to_arrays()
+        arrays["ema"] = ema
+        arrays["ntrees"] = np.asarray(ntrees, np.int64)
+        _save_npz(self._sketch_path(cycle), arrays)
+        plan = {"cycle": cycle,
+                "max_score": step["max_score"],
+                "fired": bool(step["fired"]),
+                "refresh": refresh,
+                "kept": kept}
+        # the plan is the commit point: written last, so a plan on disk
+        # guarantees its sketch/cuts artifacts are complete
+        from xgboost_tpu.reliability.integrity import atomic_write
+        atomic_write(self._plan_path(cycle),
+                     (json.dumps(plan, sort_keys=True) + "\n").encode())
+        return plan
+
+    def _apply_plan(self, bst, plan: dict) -> None:
+        cycle = int(plan["cycle"])
+        if plan.get("refresh"):
+            with np.load(self._cuts_path(cycle),
+                         allow_pickle=False) as z:
+                cuts = CutMatrix(
+                    np.asarray(z["cut_values"], np.float32),
+                    np.asarray(z["n_cuts"], np.int32))
+            # idempotent: ring bytes saved after a pre-crash rebind
+            # already carry these cuts; remapping again is exact
+            if bst.gbtree is not None:
+                bst.rebind_cuts(cuts)
+        kept = plan.get("kept")
+        n_features = (bst.gbtree.cuts.num_feature
+                      if bst.gbtree is not None and bst.gbtree.cuts
+                      is not None else 0)
+        bst.set_feature_screen(kept if kept else None)
+        self.stream_metrics.kept_features.set(
+            float(len(kept) if kept else n_features))
