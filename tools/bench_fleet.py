@@ -26,7 +26,13 @@ Cells:
 Note this container is 1-CPU: replica parallelism cannot exceed one
 core, so ``router_3`` measures dispatch/retry overhead and shedding
 correctness more than parallel speedup — on a multi-core host the
-3-replica aggregate scales with cores.
+3-replica aggregate scales with cores.  Every cell records the host's
+``cpu`` block (``os.cpu_count()`` + the per-process scheduler
+affinity) so a reader can tell which regime a committed number was
+measured under, and ``--multicore-only`` re-measures the
+parallel-speedup cells (``router_3``, ``catalog_1``/``catalog_4``) and
+drops the scarce-core caveats when ≥4 effective cores are available —
+on a scarce-core host it is a deliberate no-op and the caveats stay.
 """
 
 from __future__ import annotations
@@ -78,6 +84,24 @@ def _bodies(n: int = 64):
     rng = np.random.RandomState(1)
     return [(",".join(f"{v:.6f}" for v in rng.rand(N_FEAT))).encode()
             for _ in range(n)]
+
+
+def _cpu_info() -> dict:
+    """The compute regime a cell was measured under: logical core
+    count plus the per-process scheduler affinity (cgroup/taskset caps
+    make these differ — affinity is what the replicas actually get)."""
+    info = {"cpu_count": os.cpu_count() or 1}
+    if hasattr(os, "sched_getaffinity"):
+        aff = sorted(os.sched_getaffinity(0))
+        info["affinity"] = aff
+        info["effective_cores"] = len(aff)
+    else:
+        info["effective_cores"] = info["cpu_count"]
+    return info
+
+
+def _effective_cores() -> int:
+    return _cpu_info()["effective_cores"]
 
 
 def hammer(base_url: str, total_reqs: int, clients: int,
@@ -156,6 +180,7 @@ def hammer(base_url: str, total_reqs: int, clients: int,
         "ok": counts["ok"], "shed": counts["shed"],
         "failures": counts["fail"],
         "shed_rate": round(counts["shed"] / max(done, 1), 4),
+        "cpu": _cpu_info(),
     }
     if deadline_ms is not None:
         cell.update({
@@ -302,10 +327,12 @@ def catalog_only() -> int:
         "failures": sum(c["failures"] for c in per.values()),
         "p99_ms_worst_tenant": max(c["p99_ms"] for c in per.values()),
         "per_tenant": per,
+        "cpu": _cpu_info(),
     }
-    if (os.cpu_count() or 1) <= 2:
+    if _effective_cores() <= 2:
         cat4["note"] = (
-            f"{os.cpu_count()}-core container: all four tenant engines "
+            f"{_effective_cores()}-effective-core container: all four "
+            "tenant engines "
             "share one core, so catalog_4 measures multi-model "
             "interleaving fairness and per-tenant isolation overhead, "
             "not parallel speedup — aggregate req/s stays near "
@@ -324,12 +351,65 @@ def catalog_only() -> int:
     return 0 if cat1["failures"] + cat4["failures"] == 0 else 1
 
 
+def multicore_only() -> int:
+    """Re-measure the parallel-speedup cells — ``router_3`` and the
+    catalog pair — and merge them into the committed BENCH_fleet.json,
+    dropping the scarce-core caveats.  The committed numbers were taken
+    on a 1-core container where those cells measure dispatch/isolation
+    correctness, not speedup; on a host with ≥4 effective cores this
+    replaces them with numbers the replica processes can actually
+    scale into.  On a scarce-core host it is a deliberate NO-OP: the
+    caveats stay because they are still true."""
+    import tempfile
+    cores = _effective_cores()
+    if cores < 4:
+        print(f"[bench_fleet] --multicore-only: {cores} effective "
+              "core(s) (cpu_count="
+              f"{os.cpu_count()}) — skipping the re-run; the committed "
+              "scarce-core caveats remain accurate for this host",
+              file=sys.stderr)
+        return 0
+    work = tempfile.mkdtemp(prefix="xgbtpu_benchmc_")
+    model = os.path.join(work, "model.bin")
+    print("[bench_fleet] training model...", file=sys.stderr)
+    _train_model(model)
+    print(f"[bench_fleet] router_3 re-run on {cores} cores...",
+          file=sys.stderr)
+    fl = FleetLauncher(model, replicas=3,
+                       workdir=os.path.join(work, "f3"),
+                       serve_args=SERVE_ARGS, quiet=True)
+    fl.start()
+    fl.wait_ready()
+    hammer(fl.url, min(REQS, 400), CLIENTS)  # warm the service EWMAs
+    r3 = hammer(fl.url, REQS, CLIENTS)
+    fl.stop()
+    try:
+        with open(_bench_path()) as f:
+            out = json.load(f)
+    except OSError:
+        out = {}
+    out["router_3"] = r3
+    out["value"] = r3["requests_per_sec"]
+    out["unit"] = (f"req/s aggregate (1-row CSV via router, 3 "
+                   f"subprocess replicas, {CLIENTS} clients, "
+                   f"{cores} effective cores; p99={r3['p99_ms']}ms)")
+    out.pop("note", None)   # the scarce-core caveat no longer applies
+    with open(_bench_path(), "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"router_3": r3}))
+    rc_cat = catalog_only()   # refreshes catalog_1/catalog_4 + caveat
+    return rc_cat if r3["failures"] == 0 else 1
+
+
 def main():
     import tempfile
     if "--deadline-only" in sys.argv[1:]:
         return deadline_only()
     if "--catalog-only" in sys.argv[1:]:
         return catalog_only()
+    if "--multicore-only" in sys.argv[1:]:
+        return multicore_only()
     work = tempfile.mkdtemp(prefix="xgbtpu_benchfleet_")
     model = os.path.join(work, "model.bin")
     print("[bench_fleet] training model...", file=sys.stderr)
@@ -380,9 +460,10 @@ def main():
                    f"subprocess replicas, {CLIENTS} clients, CPU "
                    f"{os.cpu_count()}-core; p99="
                    f"{out['router_3']['p99_ms']}ms)")
-    if (os.cpu_count() or 1) <= 2:
+    if _effective_cores() <= 2:
         out["note"] = (
-            f"{os.cpu_count()}-core container: the 3 replica processes "
+            f"{_effective_cores()}-effective-core container: the 3 "
+            "replica processes "
             "share one core, so router_3 measures dispatch/retry/shed "
             "correctness rather than parallel speedup — replica "
             "scaling needs cores to scale onto (compare router_1 vs "

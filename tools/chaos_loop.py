@@ -70,6 +70,22 @@ consuming the SAME spool re-publishes the identical per-cycle hash
 sequence and identical final model bytes.  Emits
 ``STREAM_CHAOS.json``.
 
+``--placer`` switches to the AUTONOMOUS-PLACEMENT chaos mode
+(SERVING.md "Autonomous placement"): a router + N default-only catalog
+replicas + a ``task=placer`` subprocess managing a 4-tenant manifest
+(``placer_replication=2``).  Once the placer has attached every tenant,
+per-tenant clients drive ``/predict?model=...`` through the router
+while the killer (a) SIGKILLs a replica mid-rebalance (keepalive
+restarts it under a FRESH identity, so the placer must re-home, not
+wait), (b) SIGKILLs the placer itself mid-push and restarts it on the
+same ``placer_plan_path``, and (c) repeats the placer kill in a quiet
+window to pin plan-resume determinism.  A watcher samples
+``/fleet/members`` continuously; the contract is (1) zero non-shed
+client failures, (2) no tenant ever orphaned — every sample shows ≥1
+in-rotation replica advertising each tenant — and (3) the resumed
+placer reports the SAME target assignment it snapshotted before the
+kill.  Emits ``PLACER_CHAOS.json``.
+
 ``--train`` switches to the STALL-failure training mode (RELIABILITY.md
 stall matrix): each run arms a ``stall`` mock coordinate (the hang twin
 of worker death, parallel/mock.py) — and, half the time, a death
@@ -1240,6 +1256,296 @@ def catalog_mode(args) -> int:
     return 0 if ok else 1
 
 
+def placer_mode(args) -> int:
+    """Autonomous-placement chaos (see module docstring, ``--placer``):
+    SIGKILL replicas mid-rebalance AND the placer mid-push; assert zero
+    non-shed failures, no tenant ever orphaned, and that a resumed
+    placer converges to the target it snapshotted."""
+    import hashlib
+    import subprocess
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import xgboost_tpu as xgb
+    from xgboost_tpu.reliability.integrity import verify_model_bytes
+
+    work = args.workdir or tempfile.mkdtemp(prefix="xgbtpu_chaosplc_")
+    os.makedirs(work, exist_ok=True)
+    rng = np.random.RandomState(args.seed)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    # one model file, four tenant names: placement chaos is about WHERE
+    # entries live, not what they predict
+    model = os.path.join(work, "model.bin")
+    X0 = np.random.RandomState(7).rand(300, 6).astype(np.float32)
+    y0 = (X0[:, 0] + X0[:, 1] > 1.0).astype(np.float32)
+    xgb.train({"objective": "binary:logistic", "max_depth": 3,
+               "eta": 0.4, "silent": 1},
+              xgb.DMatrix(X0, label=y0), 3).save_model(model)
+    tenants = [f"t{i}" for i in range(1, 5)]
+    manifest = ",".join(f"{t}={model}" for t in tenants)
+    body = ",".join(f"{v:.6f}" for v in X0[0]).encode()
+
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    state_path = os.path.join(work, "router.state")
+    plan_path = os.path.join(work, "placer.plan")
+
+    rlog = open(os.path.join(work, "router.log"), "ab")
+    router = subprocess.Popen(
+        [sys.executable, "-m", "xgboost_tpu", "task=fleet_router",
+         "fleet_host=127.0.0.1", f"fleet_port={port}",
+         "fleet_lease_sec=3.0", "fleet_hc_sec=0.5",
+         f"fleet_state_path={state_path}", "silent=1"],
+        stdout=rlog, stderr=rlog, cwd=repo, env=env)
+    rlog.close()
+
+    n_reps = args.fleet_replicas
+    replicas = {}
+    next_idx = [0]
+
+    def spawn_replica():
+        # a FRESH identity per spawn: a SIGKILL'd replica's lease must
+        # EXPIRE (no re-register under the old id), so re-homing is the
+        # placer's job, not the tracker recover path's
+        i = next_idx[0]
+        next_idx[0] += 1
+        log = open(os.path.join(work, f"replica-{i}.log"), "ab")
+        replicas[i] = subprocess.Popen(
+            [sys.executable, "-m", "xgboost_tpu", "task=serve",
+             f"model_in={model}", "serve_port=0", "serve_host=127.0.0.1",
+             f"serve_router_url={url}", f"serve_replica_id=p{i}",
+             "serve_catalog_mb=64", "serve_min_bucket=8",
+             "serve_max_bucket=32", "serve_max_wait_ms=1.0",
+             "serve_poll_sec=0", "serve_warmup=0", "silent=1"],
+            stdout=log, stderr=log, cwd=repo, env=env)
+        log.close()
+        return i
+
+    placer = [None]
+
+    def spawn_placer():
+        log = open(os.path.join(work, "placer.log"), "ab")
+        placer[0] = subprocess.Popen(
+            [sys.executable, "-m", "xgboost_tpu", "task=placer",
+             f"placer_router_url={url}", f"placer_catalog={manifest}",
+             f"placer_plan_path={plan_path}", "placer_tick_sec=0.4",
+             "placer_lease_sec=3.0", "placer_replication=2",
+             "silent=1"],
+            stdout=log, stderr=log, cwd=repo, env=env)
+        log.close()
+
+    def members(timeout=5.0):
+        with urllib.request.urlopen(url + "/fleet/members",
+                                    timeout=timeout) as r:
+            return json.load(r)
+
+    def hosted_counts(mem):
+        out = {t: 0 for t in tenants}
+        for d in mem.get("replicas", []):
+            if not d.get("in_rotation"):
+                continue
+            for t in tenants:
+                if t in (d.get("models") or []):
+                    out[t] += 1
+        return out
+
+    def wait_placed(min_hosts, timeout=180.0):
+        deadline = time.perf_counter() + timeout
+        last = {}
+        while time.perf_counter() < deadline:
+            try:
+                last = hosted_counts(members())
+                if all(last.get(t, 0) >= min_hosts for t in tenants):
+                    return last
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.25)
+        raise TimeoutError(f"placement never converged: {last} "
+                           f"(see {work}/placer.log)")
+
+    def read_plan_snapshot():
+        with open(plan_path, "rb") as f:
+            state = json.loads(verify_model_bytes(f.read(), plan_path))
+        return state["target"]
+
+    def router_plan(timeout=5.0):
+        with urllib.request.urlopen(url + "/placer/status",
+                                    timeout=timeout) as r:
+            return json.load(r).get("plan") or {}
+
+    counts = {t: {"ok": 0, "shed": 0, "fail": 0} for t in tenants}
+    orphan_windows = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    watch = threading.Event()   # set once initial placement landed
+
+    def orphan_watcher():
+        # the availability contract: from first placement on, every
+        # sample of the router's view shows >=1 in-rotation advertiser
+        # per tenant (router-down windows don't blind the watcher —
+        # there is no router kill leg in this mode)
+        while not stop.is_set():
+            if watch.is_set():
+                try:
+                    mem = members(timeout=2.0)
+                except (OSError, ValueError):
+                    time.sleep(0.1)
+                    continue
+                counts_now = hosted_counts(mem)
+                bad = sorted(t for t, n in counts_now.items() if n < 1)
+                if bad:
+                    with lock:
+                        orphan_windows.append(
+                            {"t": round(time.perf_counter(), 2),
+                             "orphaned": bad})
+            time.sleep(0.05)
+
+    def post(path, data, patience=60.0):
+        deadline = time.perf_counter() + patience
+        while True:
+            req = urllib.request.Request(url + path, data=data)
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+                    return 200
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+            except OSError:
+                if time.perf_counter() >= deadline:
+                    return None
+                time.sleep(0.2)
+
+    def client(t):
+        mine = {"ok": 0, "shed": 0, "fail": 0}
+        while not stop.is_set():
+            if not watch.is_set():
+                time.sleep(0.1)
+                continue
+            status = post(f"/predict?model={t}", body)
+            mine["ok" if status == 200
+                 else "shed" if status in (429, 503, 504)
+                 else "fail"] += 1
+        with lock:
+            for k in mine:
+                counts[t][k] += mine[k]
+
+    threads = [threading.Thread(target=orphan_watcher)] + [
+        threading.Thread(target=client, args=(t,)) for t in tenants]
+    for t_ in threads:
+        t_.start()
+
+    replica_kills = placer_kills = 0
+    resume_checks = []
+    for _ in range(n_reps):
+        spawn_replica()
+    spawn_placer()
+    try:
+        print(f"[chaos-placer] waiting for initial placement "
+              f"({n_reps} replicas x 4 tenants, replication=2)...",
+              file=sys.stderr)
+        wait_placed(min_hosts=2)
+        watch.set()
+        time.sleep(2.0)                      # traffic under steady state
+
+        # ---- leg 1: SIGKILL a replica mid-rebalance, placer re-homes.
+        # The restart uses a FRESH replica id, so the placer sees a
+        # genuinely changed fleet both times.
+        victim = sorted(replicas)[int(rng.randint(len(replicas)))]
+        replicas[victim].kill()
+        replicas[victim].wait()
+        replicas.pop(victim)
+        replica_kills += 1
+        print(f"[chaos-placer] SIGKILL replica #{victim}",
+              file=sys.stderr)
+        spawn_replica()                      # keepalive replacement
+        # ---- leg 2: SIGKILL the placer MID-PUSH — right inside the
+        # re-homing window the replica kill just opened
+        time.sleep(float(rng.uniform(0.3, 0.9)))
+        placer[0].kill()
+        placer[0].wait()
+        placer_kills += 1
+        print("[chaos-placer] SIGKILL placer mid-push", file=sys.stderr)
+        spawn_placer()
+        wait_placed(min_hosts=2)             # resumed placer converges
+        time.sleep(2.0)
+
+        # ---- leg 3: quiet-window placer kill pins resume determinism:
+        # same fleet + snapshotted plan -> the resumed placer must
+        # record the SAME target on the router
+        before_snapshot = read_plan_snapshot()
+        before_plan = router_plan().get("target") or {}
+        placer[0].kill()
+        placer[0].wait()
+        placer_kills += 1
+        print("[chaos-placer] SIGKILL placer (quiet window)",
+              file=sys.stderr)
+        spawn_placer()
+        deadline = time.perf_counter() + 60.0
+        after_plan = {}
+        while time.perf_counter() < deadline:
+            try:
+                after_plan = router_plan().get("target") or {}
+            except (OSError, ValueError):
+                after_plan = {}
+            if after_plan:
+                break
+            time.sleep(0.25)
+        resume_checks.append({
+            "snapshot_equals_recorded": before_snapshot == before_plan,
+            "resumed_equals_snapshot": after_plan == before_snapshot})
+        wait_placed(min_hosts=2)
+        time.sleep(2.0)                      # post-chaos steady traffic
+    finally:
+        stop.set()
+        for t_ in threads:
+            t_.join(90.0)
+        procs = list(replicas.values()) + [router]
+        if placer[0] is not None:
+            procs.append(placer[0])
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(20.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    total_fail = sum(c["fail"] for c in counts.values())
+    total_ok = sum(c["ok"] for c in counts.values())
+    resumed_plan_equal = bool(resume_checks) and all(
+        rc["resumed_equals_snapshot"] for rc in resume_checks)
+    report = {
+        "mode": "placer", "replicas": n_reps, "tenants": len(tenants),
+        "replication": 2, "replica_kills": replica_kills,
+        "placer_kills": placer_kills,
+        "per_tenant": counts, "non_shed_failures": total_fail,
+        "orphan_windows": orphan_windows[:20],
+        "orphan_window_count": len(orphan_windows),
+        "resume_checks": resume_checks,
+        "resumed_plan_equal": resumed_plan_equal,
+        "model_sha256": hashlib.sha256(
+            open(model, "rb").read()).hexdigest(),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"[chaos-placer] {replica_kills} replica kills, "
+          f"{placer_kills} placer kills, {total_ok} ok / "
+          f"{total_fail} non-shed failures, "
+          f"{len(orphan_windows)} orphan windows, resumed_plan_equal="
+          f"{resumed_plan_equal} -> {args.out}", file=sys.stderr)
+    ok = (total_fail == 0 and not orphan_windows and total_ok > 0
+          and replica_kills >= 1 and placer_kills >= 2
+          and resumed_plan_equal
+          and all(c["ok"] > 0 for c in counts.values()))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--runs", type=int, default=10)
@@ -1299,9 +1605,18 @@ def main(argv=None) -> int:
                          "trainers AND the router (snapshot restart); "
                          "per-tenant zero-ungated contract "
                          "(CATALOG_CHAOS.json; see module docstring)")
+    ap.add_argument("--placer", action="store_true",
+                    help="autonomous-placement mode: router + default-"
+                         "only replicas + task=placer subprocess; "
+                         "SIGKILLs replicas mid-rebalance and the "
+                         "placer mid-push; zero non-shed failures, no "
+                         "tenant ever orphaned, resumed placer "
+                         "converges to its snapshotted plan "
+                         "(PLACER_CHAOS.json; see module docstring)")
     args = ap.parse_args(argv)
     if args.out is None:
         args.out = ("STREAM_CHAOS.json" if args.stream
+                    else "PLACER_CHAOS.json" if args.placer
                     else "CATALOG_CHAOS.json" if args.catalog
                     else "PIPELINE_CHAOS.json" if args.pipeline
                     else "CHAOS_fleet_slow.json"
@@ -1311,6 +1626,8 @@ def main(argv=None) -> int:
                     else "CHAOS.json")
     if args.stream:
         return stream_mode(args)
+    if args.placer:
+        return placer_mode(args)
     if args.catalog:
         return catalog_mode(args)
     if args.pipeline:

@@ -198,6 +198,53 @@ class FleetLauncher:
             f"fleet not ready: {got}/{want} replicas in rotation "
             f"after {timeout}s (see {self.workdir}/replica-*.log)")
 
+    # ------------------------------------------------------------ elastic
+    def live_indices(self) -> List[int]:
+        return [i for i, p in self.procs.items() if p.poll() is None]
+
+    def count(self) -> int:
+        return len(self.live_indices())
+
+    def spawn_next(self) -> int:
+        """Scale-up: start one more replica (fresh index, own model
+        copy).  It registers through the normal lease path and enters
+        rotation when its first health check passes."""
+        i = max(self.procs, default=-1) + 1
+        if not self.shared_model:
+            os.makedirs(os.path.dirname(self.replica_model(i)),
+                        exist_ok=True)
+            shutil.copyfile(self.model_path, self.replica_model(i))
+        self.spawn(i)
+        return i
+
+    def drain_replica(self, i: Optional[int] = None) -> Optional[str]:
+        """Scale-down: drain one replica (default: the newest).  The
+        replica's SIGTERM drain path deregisters AT DRAIN START — it
+        leaves rotation before finishing its in-flight requests, so no
+        request is lost; the router-side deregister below is the
+        belt-and-braces for a replica too wedged to announce itself.
+        The process is dropped from the keepalive set so it is not
+        resurrected.  Returns the drained replica id, or None."""
+        live = self.live_indices()
+        if not live:
+            return None
+        i = max(live) if i is None else i
+        p = self.procs.pop(i, None)
+        if p is None or p.poll() is not None:
+            return None
+        p.terminate()
+        try:
+            req = urllib.request.Request(
+                self.url + "/fleet/deregister",
+                data=json.dumps({"replica_id": f"r{i}"}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                r.read()
+        except OSError:
+            pass  # the replica's own drain deregister is the main path
+        return f"r{i}"
+
     # ------------------------------------------------------------- chaos
     def kill_replica(self, i: int) -> Optional[int]:
         """SIGKILL replica ``i`` (no drain, no deregister — the crash
@@ -251,6 +298,28 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-arg", action="append", default=[],
                     help="extra name=value passed to every replica "
                          "(repeatable)")
+    # elastic supervision (xgboost_tpu.placer.elastic, SERVING.md
+    # "Autonomous placement"): band defaults come from PLACER_PARAMS —
+    # one knob table drives the CLI and this tool alike
+    from xgboost_tpu.config import PLACER_PARAMS
+    ap.add_argument("--supervise", action="store_true",
+                    help="hold fleet utilization inside the "
+                         "[--util-low, --util-high] band by "
+                         "spawning/draining replicas")
+    ap.add_argument("--min-replicas", type=int,
+                    default=PLACER_PARAMS["placer_min_replicas"][0])
+    ap.add_argument("--max-replicas", type=int,
+                    default=PLACER_PARAMS["placer_max_replicas"][0])
+    ap.add_argument("--util-low", type=float,
+                    default=PLACER_PARAMS["placer_util_low"][0])
+    ap.add_argument("--util-high", type=float,
+                    default=PLACER_PARAMS["placer_util_high"][0])
+    ap.add_argument("--util-alpha", type=float,
+                    default=PLACER_PARAMS["placer_util_alpha"][0])
+    ap.add_argument("--replica-slots", type=int,
+                    default=PLACER_PARAMS["placer_replica_slots"][0])
+    ap.add_argument("--cooldown-sec", type=float,
+                    default=PLACER_PARAMS["placer_cooldown_sec"][0])
     args = ap.parse_args(argv)
 
     fl = FleetLauncher(args.model, replicas=args.replicas,
@@ -265,6 +334,23 @@ def main(argv=None) -> int:
     print(f"[fleet] up: {args.replicas} replicas in rotation "
           f"(logs in {args.workdir}/)", file=sys.stderr)
 
+    supervisor = None
+    if args.supervise:
+        from xgboost_tpu.placer import ElasticSupervisor
+        supervisor = ElasticSupervisor(
+            fl.url, spawn_fn=fl.spawn_next, drain_fn=fl.drain_replica,
+            count_fn=fl.count,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            util_low=args.util_low, util_high=args.util_high,
+            util_alpha=args.util_alpha,
+            replica_slots=args.replica_slots,
+            cooldown_sec=args.cooldown_sec)
+        print(f"[fleet] supervising: util band "
+              f"[{args.util_low}, {args.util_high}], "
+              f"{args.min_replicas}..{args.max_replicas} replicas",
+              file=sys.stderr)
+
     stop = []
     signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
     try:
@@ -275,6 +361,10 @@ def main(argv=None) -> int:
                 if n:
                     print(f"[fleet] keepalive restarted {n} replica(s)",
                           file=sys.stderr)
+            if supervisor is not None:
+                st = supervisor.tick()
+                if st["state"] not in ("steady",):
+                    print(f"[fleet] supervisor: {st}", file=sys.stderr)
     except KeyboardInterrupt:
         pass
     finally:

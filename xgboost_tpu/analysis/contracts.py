@@ -371,7 +371,8 @@ def _collect_param_table(ctx: FileContext, node, facts: Facts) -> None:
     table = {"SERVE_PARAMS": "serve", "FLEET_PARAMS": "fleet",
              "PIPELINE_PARAMS": "pipeline",
              "STREAM_PARAMS": "stream",
-             "CATALOG_PARAMS": "catalog"}.get(name)
+             "CATALOG_PARAMS": "catalog",
+             "PLACER_PARAMS": "placer"}.get(name)
     if table is None or not isinstance(node.value, ast.Dict):
         return
     for k in node.value.keys:
@@ -805,7 +806,7 @@ class ContractEngine:
             families.setdefault(fam, label)
         params: Dict[str, List[str]] = {"serve": [], "fleet": [],
                                         "pipeline": [], "catalog": [],
-                                        "stream": []}
+                                        "stream": [], "placer": []}
         for _, table, key, _ in facts.params:
             if key not in params[table]:
                 params[table].append(key)

@@ -218,7 +218,8 @@ class HostSyncInHotLoop(Rule):
 
     HOT_PATHS = ("models/gbtree.py", "models/updaters.py", "ops/",
                  "serving/engine.py", "serving/featurestore.py",
-                 "fleet/", "pipeline/", "catalog/", "stream/")
+                 "fleet/", "pipeline/", "catalog/", "stream/",
+                 "placer/")
 
     def applies(self, path: str) -> bool:
         return _path_has(path, self.HOT_PATHS)

@@ -218,6 +218,29 @@ class ModelCatalog:
             self._note_gauges_locked()
         return entry
 
+    def remove_model(self, name: str) -> bool:
+        """Detach a named model (the placer's manifest-delta remove
+        path): evict its engine if resident, drop the entry, stop
+        advertising it on the next heartbeat.  The default model is
+        pinned (the HTTP tier's single-model attributes alias it) —
+        removing it raises.  Returns False for a name the catalog does
+        not hold (detach is idempotent)."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                return False
+            if name == self.default:
+                raise ValueError(
+                    f"model {name!r} is the catalog default and cannot "
+                    "be detached")
+            if entry.resident:
+                self._evict_locked(entry)
+            del self._entries[name]
+            self.metrics.models_configured.set(len(self._entries))
+            self._note_gauges_locked()
+        event("catalog.remove", model=name)
+        return True
+
     @classmethod
     def from_manifest(cls, manifest: Dict[str, str], **kwargs
                       ) -> "ModelCatalog":
@@ -352,9 +375,12 @@ class ModelCatalog:
         """The advertisement the replica's heartbeat carries: every
         configured model (resident or not — an evicted model is still
         SERVABLE, it just re-admits on first hit) with the content hash
-        it would serve."""
+        it would serve and its current device-byte footprint (0 while
+        evicted — the placer falls back to manifest file size for
+        cost)."""
         with self._lock:
-            return {e.name: {"path": e.path, "hash": e.content_hash()}
+            return {e.name: {"path": e.path, "hash": e.content_hash(),
+                             "bytes": e.device_bytes()}
                     for e in self._entries.values()}
 
     def describe(self) -> dict:

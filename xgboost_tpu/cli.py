@@ -32,8 +32,9 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from xgboost_tpu.config import (CATALOG_PARAMS, FLEET_PARAMS,
-                                PIPELINE_PARAMS, SERVE_PARAMS,
-                                STREAM_PARAMS, parse_config_file)
+                                PIPELINE_PARAMS, PLACER_PARAMS,
+                                SERVE_PARAMS, STREAM_PARAMS,
+                                parse_config_file)
 
 # process start, for recovery-cost accounting.  perf_counter, not
 # wall-clock: these readings are only ever subtracted (XGT006)
@@ -62,6 +63,12 @@ Tasks (task=...):
           candidate against the incumbent on a holdout, and atomically
           publish to the path the serving tier polls — directly or
           through the fleet canary lane (pipeline_router_url=)
+  placer  autonomous catalog placement (xgboost_tpu.placer, SERVING.md
+          "Autonomous placement"): watch the router's per-tenant load,
+          bin-pack placer_catalog models onto in-rotation replicas
+          within their device budgets, and converge the fleet by
+          pushing manifest deltas (elastic resizing rides
+          tools/launch_fleet.py --supervise)
 
 Observability (OBSERVABILITY.md): obs_log=PATH appends a crash-safe
 JSONL timeline (render: tools/obs_report.py); metrics_port=N serves
@@ -81,6 +88,9 @@ task=stream parameters (streaming drift-aware continuous learning):
 
 catalog parameters (multi-tenant serving, task=serve + task=fleet_router):
 {catalog_params}
+
+task=placer parameters (autonomous placement + elastic fleet):
+{placer_params}
 """
 
 
@@ -127,6 +137,8 @@ class BoostLearnTask:
                               for k, (v, _) in STREAM_PARAMS.items()}
         self.catalog_params = {k: v
                                for k, (v, _) in CATALOG_PARAMS.items()}
+        self.placer_params = {k: v
+                              for k, (v, _) in PLACER_PARAMS.items()}
 
     # ------------------------------------------------------------- params
     _OWN = {
@@ -207,6 +219,8 @@ class BoostLearnTask:
             self.stream_params[name] = type(STREAM_PARAMS[name][0])(val)
         elif name in self.catalog_params:
             self.catalog_params[name] = type(CATALOG_PARAMS[name][0])(val)
+        elif name in self.placer_params:
+            self.placer_params[name] = type(PLACER_PARAMS[name][0])(val)
         else:
             m = re.match(r"eval\[([^\]]+)\]", name)
             if m:
@@ -223,13 +237,15 @@ class BoostLearnTask:
             from xgboost_tpu.config import (catalog_params_help,
                                             fleet_params_help,
                                             pipeline_params_help,
+                                            placer_params_help,
                                             serve_params_help,
                                             stream_params_help)
             print(_USAGE.format(serve_params=serve_params_help(),
                                 fleet_params=fleet_params_help(),
                                 pipeline_params=pipeline_params_help(),
                                 stream_params=stream_params_help(),
-                                catalog_params=catalog_params_help()))
+                                catalog_params=catalog_params_help(),
+                                placer_params=placer_params_help()))
             return 0
         if os.path.exists(argv[0]) or "=" not in argv[0]:
             for name, val in parse_config_file(argv[0]):
@@ -373,6 +389,8 @@ class BoostLearnTask:
             return self.task_pipeline()
         if self.task == "stream":
             return self.task_stream()
+        if self.task == "placer":
+            return self.task_placer()
         raise ValueError(f"unknown task {self.task!r}")
 
     # ------------------------------------------------------------- helpers
@@ -649,6 +667,39 @@ class BoostLearnTask:
                 "gate_p99_ms": fp["fleet_gate_p99_ms"],
             },
             quiet=self.silent != 0, block=True)
+        return 0
+
+    # ------------------------------------------------------------- placer
+    def task_placer(self) -> int:
+        """Run the autonomous placement controller (xgboost_tpu.placer,
+        SERVING.md "Autonomous placement") against a fleet router:
+        watch per-tenant load, bin-pack the ``placer_catalog`` models
+        onto in-rotation replicas, push manifest deltas until the fleet
+        converges.  Loops until SIGTERM/Ctrl-C."""
+        from xgboost_tpu.catalog import parse_manifest
+        from xgboost_tpu.placer import run_placer
+        pp = self.placer_params
+        router_url = pp["placer_router_url"]
+        if not router_url:
+            raise ValueError("task=placer requires placer_router_url=")
+        if not pp["placer_catalog"]:
+            raise ValueError("task=placer requires placer_catalog= "
+                             "(name=path,... or a manifest file)")
+        manifest = parse_manifest(pp["placer_catalog"])
+        if self.silent < 2:
+            print(f"[placer] managing {len(manifest)} tenant(s) on "
+                  f"{router_url}", file=sys.stderr)
+        run_placer(
+            router_url, manifest,
+            plan_path=pp["placer_plan_path"],
+            placer_id=pp["placer_id"],
+            tick_sec=pp["placer_tick_sec"],
+            lease_sec=pp["placer_lease_sec"],
+            replication=pp["placer_replication"],
+            hot_replication=pp["placer_hot_replication"],
+            hot_fraction=pp["placer_hot_fraction"],
+            load_alpha=pp["placer_load_alpha"],
+            block=True)
         return 0
 
     # ----------------------------------------------------------- pipeline

@@ -560,6 +560,71 @@ def catalog_params_help() -> str:
                      for name, (default, help_) in CATALOG_PARAMS.items())
 
 
+# --------------------------------------------------------------- placer
+# Autonomous placement + elastic fleet (xgboost_tpu.placer, SERVING.md
+# "Autonomous placement"): knobs for task=placer — the control plane
+# that decides which replicas host which catalog models and how many
+# replicas the fleet should run.  Same single-table discipline as the
+# other *_PARAMS tables (XGT010 + contracts inventory section
+# "placer").
+PLACER_PARAMS: Dict[str, Tuple[Any, str]] = {
+    "placer_router_url": ("", "base URL of the fleet router whose "
+                              "catalog the placer manages (required "
+                              "for task=placer)"),
+    "placer_catalog": ("", "tenant manifest the placer places: inline "
+                           "'name=path,name=path' pairs or a 'name = "
+                           "path' config file — same syntax as "
+                           "catalog="),
+    "placer_plan_path": ("", "CRC-footered snapshot of the target "
+                             "assignment; a restarted placer resumes "
+                             "this plan instead of replanning from "
+                             "scratch (empty = no snapshot)"),
+    "placer_id": ("", "placer identity for the router-side single-"
+                      "holder lease (default: host:pid)"),
+    "placer_tick_sec": (2.0, "control-loop period: scrape load, "
+                             "replan, push manifest deltas (jittered "
+                             "±20%)"),
+    "placer_lease_sec": (10.0, "router-side placer lease: a standby "
+                               "placer takes over this long after the "
+                               "holder's last renewal"),
+    "placer_replication": (1, "replication floor — every tenant is "
+                              "placed on at least this many in-"
+                              "rotation replicas (capped by fleet "
+                              "size)"),
+    "placer_hot_replication": (2, "replication floor for HOT tenants "
+                                  "(load share >= placer_hot_fraction)"),
+    "placer_hot_fraction": (0.5, "a tenant whose share of observed "
+                                 "request load meets this fraction is "
+                                 "hot and gets the raised floor"),
+    "placer_load_alpha": (0.3, "EWMA smoothing for per-tenant request "
+                               "rates scraped from the router's "
+                               "xgbtpu_tenant_* counters"),
+    "placer_util_low": (0.2, "elastic band floor: fleet in-flight/"
+                             "slot utilization (EWMA) below this "
+                             "drains one replica"),
+    "placer_util_high": (0.75, "elastic band ceiling: utilization "
+                               "above this spawns one replica"),
+    "placer_util_alpha": (0.3, "EWMA smoothing for the fleet "
+                               "utilization signal"),
+    "placer_replica_slots": (8, "nominal concurrent requests one "
+                                "replica absorbs; utilization = "
+                                "in-flight / (slots * replicas)"),
+    "placer_cooldown_sec": (10.0, "minimum gap between elastic "
+                                  "resizes, so one burst cannot "
+                                  "thrash the fleet size"),
+    "placer_min_replicas": (1, "elastic supervisor never drains the "
+                               "fleet below this many replicas"),
+    "placer_max_replicas": (8, "elastic supervisor never spawns the "
+                               "fleet above this many replicas"),
+}
+
+
+def placer_params_help() -> str:
+    """One line per task=placer parameter, for CLI usage text."""
+    return "\n".join(f"  {name:<26} {help_} (default {default!r})"
+                     for name, (default, help_) in PLACER_PARAMS.items())
+
+
 def parse_config_file(path: str) -> List[Tuple[str, str]]:
     """Parse a ``name = value`` config file.
 

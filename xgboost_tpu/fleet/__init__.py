@@ -34,7 +34,9 @@ serve_router_url=http://127.0.0.1:8000``.
 from xgboost_tpu.fleet.membership import (HashRing, LeaseClient,
                                           Membership, Replica)
 from xgboost_tpu.fleet.router import FleetRouter, run_router
-from xgboost_tpu.fleet.rollout import RolloutController, scrape_samples
+from xgboost_tpu.fleet.rollout import (RolloutController,
+                                       scrape_labeled_samples,
+                                       scrape_samples)
 
 __all__ = [
     "Membership",
@@ -45,4 +47,5 @@ __all__ = [
     "run_router",
     "RolloutController",
     "scrape_samples",
+    "scrape_labeled_samples",
 ]

@@ -54,16 +54,21 @@ class Replica:
                  model_path: Optional[str] = None,
                  model_hash: Optional[str] = None,
                  pid: Optional[int] = None,
-                 models: Optional[Dict[str, dict]] = None):
+                 models: Optional[Dict[str, dict]] = None,
+                 device: Optional[dict] = None):
         self.replica_id = replica_id
         self.url = url.rstrip("/")
         self.model_path = model_path
         self.model_hash = model_hash
         self.pid = pid
-        # catalog advertisement: {model_name: {"path":..., "hash":...}}
-        # — which named models this replica can serve (empty = a
-        # pre-catalog replica that only answers bare /predict)
+        # catalog advertisement: {model_name: {"path":..., "hash":...,
+        # "bytes":...}} — which named models this replica can serve
+        # (empty = a pre-catalog replica that only answers bare
+        # /predict)
         self.models: Dict[str, dict] = dict(models or {})
+        # device budget advertisement: {"budget_bytes":..,
+        # "used_bytes":..} — the placer bin-packs against this
+        self.device: dict = dict(device or {})
         self.lease_deadline = 0.0       # monotonic
         self.registered_count = 0       # bumps on every (re-)register
         self.health_ok = True           # last /healthz verdict
@@ -98,6 +103,8 @@ class Replica:
             "model_path": self.model_path,
             "model_hash": self.model_hash,
             "models": sorted(self.models),
+            "models_detail": {m: dict(v) for m, v in self.models.items()},
+            "device": dict(self.device),
             "pid": self.pid,
             "lease_remaining_sec": round(self.lease_deadline - now, 3),
             "health_ok": self.health_ok,
@@ -197,7 +204,8 @@ class Membership:
                  model_path: Optional[str] = None,
                  model_hash: Optional[str] = None,
                  pid: Optional[int] = None,
-                 models: Optional[Dict[str, dict]] = None) -> dict:
+                 models: Optional[Dict[str, dict]] = None,
+                 device: Optional[dict] = None) -> dict:
         """Add (or revive — the tracker ``recover`` path) a replica and
         grant a heartbeat lease.  Returns the lease grant."""
         from xgboost_tpu.obs import event
@@ -208,7 +216,7 @@ class Membership:
             recovered = rep is not None
             if rep is None:
                 rep = Replica(replica_id, url, model_path, model_hash, pid,
-                              models=models)
+                              models=models, device=device)
                 self._replicas[replica_id] = rep
             else:
                 # a restarted process re-registers under its old id:
@@ -223,6 +231,8 @@ class Membership:
                 rep.pid = pid if pid is not None else rep.pid
                 if models is not None:
                     rep.models = dict(models)
+                if device is not None:
+                    rep.device = dict(device)
                 rep.breaker = BREAKER_CLOSED
                 rep.consecutive_failures = 0
                 rep.probe_inflight = False
@@ -249,13 +259,20 @@ class Membership:
 
     def heartbeat(self, replica_id: str,
                   model_hash: Optional[str] = None,
-                  models: Optional[Dict[str, dict]] = None) -> bool:
+                  models: Optional[Dict[str, dict]] = None,
+                  device: Optional[dict] = None) -> bool:
         """Renew a lease.  False = unknown replica (the client should
         re-register — its lease expired or the router restarted).
-        ``models`` keeps the catalog advertisement fresh — a rollout
-        that bumps one tenant's hash shows up here within a lease
-        period."""
+        ``models``/``device`` keep the catalog + budget advertisement
+        fresh: the payload is DIFFED against the table so a mid-lease
+        catalog change (placement delta, eviction, rollout hash bump)
+        is visible as an event the moment it lands — model-aware
+        routing and the placer never act on a map older than one
+        heartbeat."""
         now = time.monotonic()
+        added: List[str] = []
+        removed: List[str] = []
+        changed: List[str] = []
         with self._lock:
             rep = self._replicas.get(replica_id)
             if rep is None:
@@ -263,9 +280,22 @@ class Membership:
             rep.lease_deadline = now + self.lease_sec
             if model_hash:
                 rep.model_hash = model_hash
-            if models is not None:
+            if models is not None and models != rep.models:
+                added = sorted(m for m in models if m not in rep.models)
+                removed = sorted(m for m in rep.models if m not in models)
+                changed = sorted(
+                    m for m in models if m in rep.models
+                    and models[m] != rep.models[m])
                 rep.models = dict(models)
-            return True
+            if device is not None:
+                rep.device = dict(device)
+        if added or removed or changed:
+            from xgboost_tpu.obs import event
+            from xgboost_tpu.obs.metrics import fleet_metrics
+            fleet_metrics().advert_updates.inc()
+            event("fleet.models_changed", replica_id=replica_id,
+                  added=added, removed=removed, changed=changed)
+        return True
 
     def deregister(self, replica_id: str) -> bool:
         """Remove a replica (drain shutdown announces itself)."""
@@ -350,7 +380,7 @@ class Membership:
             return {"replicas": [
                 {"replica_id": r.replica_id, "url": r.url,
                  "model_path": r.model_path, "model_hash": r.model_hash,
-                 "pid": r.pid, "models": r.models}
+                 "pid": r.pid, "models": r.models, "device": r.device}
                 for r in self._replicas.values() if r.lease_live(now)]}
 
     def restore(self, state: dict) -> int:
@@ -366,7 +396,8 @@ class Membership:
                               model_path=d.get("model_path"),
                               model_hash=d.get("model_hash"),
                               pid=d.get("pid"),
-                              models=d.get("models"))
+                              models=d.get("models"),
+                              device=d.get("device"))
                 n += 1
             except (KeyError, TypeError) as e:
                 from xgboost_tpu.obs.metrics import swallowed_error
@@ -696,6 +727,7 @@ class LeaseClient:
                  model_path: Optional[str] = None,
                  model_hash_fn: Optional[Callable[[], Optional[str]]] = None,
                  models_fn: Optional[Callable[[], dict]] = None,
+                 device_fn: Optional[Callable[[], Optional[dict]]] = None,
                  on_kill: Optional[Callable[[], None]] = None):
         self.router_url = router_url.rstrip("/")
         self.replica_id = replica_id
@@ -703,8 +735,12 @@ class LeaseClient:
         self.model_path = model_path
         self.model_hash_fn = model_hash_fn or (lambda: None)
         # catalog advertisement: () -> {name: {"path":..., "hash":...}}
-        # carried on register AND every heartbeat (rollouts move hashes)
+        # carried on register AND every heartbeat (rollouts move
+        # hashes, placement deltas move whole entries)
         self.models_fn = models_fn or (lambda: None)
+        # device budget advertisement: () -> {"budget_bytes":..,
+        # "used_bytes":..} — the placer bin-packs against this
+        self.device_fn = device_fn or (lambda: None)
         self.on_kill = on_kill or (lambda: os._exit(43))
         self.lease_sec = 10.0
         self.registered = False
@@ -731,6 +767,7 @@ class LeaseClient:
                 "model_path": self.model_path,
                 "model_hash": self.model_hash_fn(),
                 "models": self.models_fn(),
+                "device": self.device_fn(),
                 "pid": os.getpid(),
             })
             self.lease_sec = float(grant.get("lease_sec", self.lease_sec))
@@ -757,7 +794,8 @@ class LeaseClient:
             resp = self._post("/fleet/heartbeat",
                               {"replica_id": self.replica_id,
                                "model_hash": self.model_hash_fn(),
-                               "models": self.models_fn()})
+                               "models": self.models_fn(),
+                               "device": self.device_fn()})
             self.heartbeats_sent += 1
             if not resp.get("known", True):
                 # the router forgot us (restart / expired lease):

@@ -60,6 +60,35 @@ def scrape_samples(text: str) -> Dict[str, float]:
     return out
 
 
+# one-label samples (name{label="value"} value) — the shape every
+# LabeledCounter/LabeledGauge in obs/metrics.py renders
+_LABELED_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)\{([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"\} '
+    r"([-+0-9.eEnaif]+)$")
+
+
+def scrape_labeled_samples(text: str, family: str
+                           ) -> Dict[str, float]:
+    """Parse the single-label samples of one metric ``family`` out of a
+    Prometheus text exposition: label value -> sample value.  The
+    placer reads per-tenant load this way
+    (``xgbtpu_tenant_requests_total{model="a"} 42`` -> ``{"a": 42.0}``);
+    :func:`scrape_samples` deliberately skips labeled samples, so this
+    is its labeled counterpart rather than a change to the gate's
+    parser."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _LABELED_RE.match(line.strip())
+        if m and m.group(1) == family:
+            try:
+                out[m.group(3)] = float(m.group(4))
+            except ValueError:
+                continue
+    return out
+
+
 class RolloutController:
     """Drives staged rollouts over a :class:`Membership` using the
     router's forward function (``(rep, method, path_qs, body, headers)

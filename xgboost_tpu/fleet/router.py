@@ -174,6 +174,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if url.path == "/fleet/rollout":
             self._send_json(200, rt.rollout_status())
             return
+        if url.path == "/placer/status":
+            self._send_json(200, rt.placer_status())
+            return
         self._send_json(404, {"error": f"no route {url.path}"})
 
     # --------------------------------------------------------------- POST
@@ -206,6 +209,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if url.path == "/fleet/rollback":
             self._fleet_rollback(body)
             return
+        if url.path == "/placer/lease":
+            self._placer_lease(body)
+            return
+        if url.path == "/placer/plan":
+            self._placer_plan(body)
+            return
         self._send_json(404, {"error": f"no route {url.path}"})
 
     # ----------------------------------------------------- replica protocol
@@ -219,7 +228,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
         grant = self.server.router.membership.register(
             rid, rurl, model_path=req.get("model_path"),
             model_hash=req.get("model_hash"), pid=req.get("pid"),
-            models=req.get("models"))
+            models=req.get("models"), device=req.get("device"))
         self.server.router.save_state()
         self._send_json(200, grant)
 
@@ -232,7 +241,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return
         known = self.server.router.membership.heartbeat(
             rid, model_hash=req.get("model_hash"),
-            models=req.get("models"))
+            models=req.get("models"), device=req.get("device"))
         # 200 either way: "known": false tells the client to re-register
         # (the tracker recover path) without an error-path round trip
         self._send_json(200, {"known": known})
@@ -247,6 +256,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
         removed = self.server.router.membership.deregister(rid)
         self.server.router.save_state()
         self._send_json(200, {"removed": removed})
+
+    # -------------------------------------------------------------- placer
+    def _placer_lease(self, body: bytes) -> None:
+        try:
+            req = json.loads(body)
+            placer_id = str(req["placer_id"])
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        self._send_json(200, self.server.router.placer_acquire(
+            placer_id, lease_sec=req.get("lease_sec")))
+
+    def _placer_plan(self, body: bytes) -> None:
+        try:
+            req = json.loads(body)
+            placer_id = str(req["placer_id"])
+            plan = dict(req["plan"])
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        code, resp = self.server.router.placer_record_plan(
+            placer_id, plan)
+        self._send_json(code, resp)
 
     # ------------------------------------------------------------- rollout
     def _fleet_rollout(self, body: bytes) -> None:
@@ -470,6 +502,14 @@ class FleetRouter:
         self._rollout_lock = threading.Lock()
         self._rollout_state: dict = {}   # model-file backups for rollback
         self._last_rollout: dict = {"status": "none"}
+        # placer single-holder lease + last recorded target plan: one
+        # placer drives placement at a time; a standby that polls
+        # /placer/lease takes over only after the holder's lease decays
+        self._placer_lock = threading.Lock()
+        self._placer_holder: Optional[str] = None
+        self._placer_deadline = 0.0      # monotonic
+        self._placer_lease_sec = max(float(lease_sec), 1.0)
+        self._placer_plan: dict = {}
         self._stop = threading.Event()
         self._hc_thread: Optional[threading.Thread] = None
         self._httpd = ThreadingHTTPServer((host, port), _RouterHandler)
@@ -925,6 +965,10 @@ class FleetRouter:
             "inflight": self._inflight,
             "inflight_budget": self.inflight_budget,
             "models": self.membership.models_hosted(),
+            # the elastic supervisor pins the fleet size while a
+            # rollout/canary soak runs — a drain mid-soak would remove
+            # the soak's pinned path-groups and invalidate the gate
+            "rollout_in_progress": self._rollout_lock.locked(),
             "uptime_seconds": round(time.perf_counter() - self.t0, 3),
         }
 
@@ -979,6 +1023,59 @@ class FleetRouter:
     def rollout_status(self) -> dict:
         with self._inflight_lock:
             return dict(self._last_rollout)
+
+    # --------------------------------------------------------------- placer
+    def placer_acquire(self, placer_id: str,
+                       lease_sec: Optional[float] = None) -> dict:
+        """Grant (or renew) the single-holder placer lease.  A second
+        placer asking while the lease is live is told who holds it and
+        stands by; the holder renews by re-asking.  Monotonic clock
+        throughout (XGT006)."""
+        from xgboost_tpu.obs import event
+        now = time.monotonic()
+        sec = float(lease_sec) if lease_sec else self._placer_lease_sec
+        renewal = False
+        with self._placer_lock:
+            free = (self._placer_holder is None
+                    or now >= self._placer_deadline
+                    or self._placer_holder == placer_id)
+            took_over = free and self._placer_holder not in (None,
+                                                             placer_id)
+            if free:
+                renewal = self._placer_holder == placer_id
+                self._placer_holder = placer_id
+                self._placer_deadline = now + sec
+                self._placer_lease_sec = sec
+            holder = self._placer_holder
+        if free and not renewal:
+            event("placer.lease", placer_id=placer_id,
+                  took_over=took_over)
+        return {"granted": free, "holder": holder, "lease_sec": sec}
+
+    def placer_record_plan(self, placer_id: str,
+                           plan: dict) -> Tuple[int, dict]:
+        """Record the placer's target assignment (observability +
+        takeover hand-off).  Only the lease holder may write — a
+        zombie placer that lost its lease gets 409, not a split-brain
+        plan."""
+        now = time.monotonic()
+        with self._placer_lock:
+            if (self._placer_holder != placer_id
+                    or now >= self._placer_deadline):
+                return 409, {"error": "not the placer lease holder",
+                             "holder": self._placer_holder}
+            self._placer_plan = dict(plan)
+        return 200, {"recorded": True}
+
+    def placer_status(self) -> dict:
+        now = time.monotonic()
+        with self._placer_lock:
+            return {
+                "holder": self._placer_holder,
+                "lease_remaining_sec": round(
+                    max(self._placer_deadline - now, 0.0), 3),
+                "plan": dict(self._placer_plan),
+            }
 
     # ---------------------------------------------------------- lifecycle
     def _hc_loop(self) -> None:

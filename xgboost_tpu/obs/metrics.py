@@ -886,11 +886,20 @@ class FleetMetrics:
         self.replica_latency = LabeledGauge(
             f"{p}_replica_latency_ewma_seconds", "replica",
             "per-replica EWMA of router-observed dispatch latency")
+        # heartbeat payload drift fix: every advertisement change the
+        # membership table absorbs mid-lease (catalog delta, eviction)
+        # is counted, so "how stale could the routing map have been"
+        # is answerable from a scrape
+        self.advert_updates = Counter(
+            f"{p}_advert_updates_total",
+            "heartbeats whose model/device advertisement differed "
+            "from the membership table (map updated in place)")
         self._all = (self.requests, self.errors, self.latency, self.shed,
                      self.retries, self.breaker_trips, self.breaker_open,
                      self.members, self.members_registered, self.inflight,
                      self.rollouts, self.rollbacks, self.slow_ejections,
-                     self.ejected, self.replica_latency)
+                     self.ejected, self.replica_latency,
+                     self.advert_updates)
         registry().register("fleet", self.render)
 
     def render(self) -> str:
@@ -1015,6 +1024,86 @@ def tenant_metrics() -> TenantMetrics:
             if _TENANT is None:
                 _TENANT = TenantMetrics()
     return _TENANT
+
+
+# ------------------------------------------------------------------ placer
+class PlacerMetrics:
+    """Control-plane accounting for the autonomous placer
+    (``xgbtpu_placer_*``, SERVING.md "Autonomous placement"): plan
+    churn, manifest-delta pushes, convergence state, and the elastic
+    supervisor's band/resize activity.  One instance per process
+    (:func:`placer_metrics`); rendered into every /metrics body via
+    the registry."""
+
+    def __init__(self, prefix: str = "xgbtpu_placer"):
+        p = prefix
+        self.ticks = Counter(
+            f"{p}_ticks_total",
+            "placement control-loop iterations (lease held)")
+        self.standby_ticks = Counter(
+            f"{p}_standby_ticks_total",
+            "iterations skipped because another placer holds the lease")
+        self.plans = Counter(
+            f"{p}_plans_total",
+            "target assignments computed that differ from the last")
+        self.moves = LabeledCounter(
+            f"{p}_moves_total", "kind",
+            "tenant placement deltas decided, kind=attach|detach")
+        self.pushes = Counter(
+            f"{p}_pushes_total",
+            "manifest-delta pushes sent to replica admin surfaces")
+        self.push_errors = Counter(
+            f"{p}_push_errors_total",
+            "manifest-delta pushes that failed (replica unreachable "
+            "or rejected)")
+        self.tenants = Gauge(
+            f"{p}_tenants",
+            "tenant models under placer management")
+        self.tenants_placed = Gauge(
+            f"{p}_tenants_placed",
+            "managed tenants with >=1 in-rotation host advertising "
+            "them")
+        self.converged = Gauge(
+            f"{p}_converged",
+            "1 while the fleet's advertised hosting matches the "
+            "target assignment")
+        self.fleet_util = Gauge(
+            f"{p}_fleet_utilization",
+            "EWMA of fleet in-flight / (replica_slots * replicas), "
+            "the elastic band signal")
+        self.replicas_target = Gauge(
+            f"{p}_replicas_target",
+            "replica count the elastic supervisor is converging to")
+        self.resizes = LabeledCounter(
+            f"{p}_resizes_total", "direction",
+            "elastic resizes executed, direction=up|down")
+        self.resize_holds = Counter(
+            f"{p}_resize_holds_total",
+            "resizes deferred because a rollout/canary soak was in "
+            "flight (path-group pinning)")
+        self._all = (self.ticks, self.standby_ticks, self.plans,
+                     self.moves, self.pushes, self.push_errors,
+                     self.tenants, self.tenants_placed, self.converged,
+                     self.fleet_util, self.replicas_target, self.resizes,
+                     self.resize_holds)
+        registry().register("placer", self.render)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._all)
+
+
+_PLACER: Optional[PlacerMetrics] = None
+_PLACER_LOCK = threading.Lock()
+
+
+def placer_metrics() -> PlacerMetrics:
+    """The process-wide PlacerMetrics singleton."""
+    global _PLACER
+    if _PLACER is None:
+        with _PLACER_LOCK:
+            if _PLACER is None:
+                _PLACER = PlacerMetrics()
+    return _PLACER
 
 
 # ----------------------------------------------------------------- serving

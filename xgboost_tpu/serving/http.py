@@ -289,7 +289,57 @@ class _Handler(BaseHTTPRequestHandler):
                             {"rolled_back": ok,
                              "model_version": reg.version})
             return
+        if url.path == "/-/catalog":
+            self._catalog_delta(body)
+            return
         self._send_json(404, {"error": f"no route {url.path}"})
+
+    def _catalog_delta(self, body: str) -> None:
+        """Placer manifest delta: ``{"add": {name: path, ...},
+        "remove": [name, ...]}``.  Attach is tolerant — a name the
+        catalog already holds is skipped, not an error — so a placer
+        retrying a push after a timeout converges instead of failing;
+        attached models admit lazily on first resolve (or eagerly via a
+        follow-up ``/-/reload?model=``).  Detach refuses the pinned
+        default (409) and is idempotent for unknown names."""
+        import os as _os
+        from xgboost_tpu.obs import event
+        ps: PredictServer = self.server.pserver
+        if ps.catalog is None:
+            self._send_json(409, {"error": "no catalog on this replica"})
+            return
+        try:
+            req = json.loads(body) if body.strip() else {}
+            add = {str(k): str(v)
+                   for k, v in dict(req.get("add") or {}).items()}
+            remove = [str(n) for n in list(req.get("remove") or [])]
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        added, skipped, removed, errors = [], [], [], []
+        for name, path in sorted(add.items()):
+            if not _os.path.exists(path):
+                errors.append(f"{name}: no model file at {path!r}")
+                continue
+            try:
+                ps.catalog.add_model(name, path)
+                added.append(name)
+            except ValueError:
+                # already attached (placer retry / concurrent push)
+                skipped.append(name)
+        for name in remove:
+            try:
+                if ps.catalog.remove_model(name):
+                    removed.append(name)
+            except ValueError as e:  # pinned default
+                errors.append(str(e))
+        if added or removed:
+            event("serving.catalog_delta", added=added, removed=removed,
+                  skipped=skipped, errors=len(errors))
+        self._send_json(200 if not errors else 409,
+                        {"added": added, "removed": removed,
+                         "skipped": skipped, "errors": errors,
+                         "models": ps.catalog.names()})
 
     # ------------------------------------------------------------ catalog
     def _resolve_entry(self, url, sp=None):
@@ -848,6 +898,12 @@ class PredictServer:
             # to replicas that actually HOST the model
             models_fn=(self.catalog.models
                        if self.catalog is not None else None),
+            # device budget advertisement: the placer bin-packs tenant
+            # models against (budget - used) per replica
+            device_fn=(
+                (lambda: {"budget_bytes": self.catalog.budget_bytes,
+                          "used_bytes": self.catalog.bytes_used()})
+                if self.catalog is not None else None),
             on_kill=on_kill)
 
     # -------------------------------------------------------- drain state
