@@ -1,0 +1,24 @@
+"""tools/chaos_loop.py --selftest wired as a tier-1 test (ISSUE 17
+satellite): the fast jax-free path exercises the pure recovery logic —
+PartitionClock fence/heal classification, the plan_degrade ladder,
+coordinator-state CRC roundtrip + corruption rejection, fail-loud
+fault-spec parsing (including the two-phase no-partial-arm guarantee),
+and the checkpoint-ring lineage scanner — so a regression in any of
+them fails CI in seconds instead of only inside the slow chaos suite.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_chaos_loop_selftest():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_loop.py"),
+         "--selftest"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "selftest: OK" in r.stdout, r.stdout[-2000:]

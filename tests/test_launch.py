@@ -11,6 +11,7 @@ import os
 import re
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -233,6 +234,260 @@ def test_stall_mock_watchdog_resume_bit_identical_composed_with_death(
     assert set(a) == set(b)
     for k in a:
         np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+# a worker that runs the REAL gang protocol mesh-free (no jax): round
+# boundaries call gang.on_round (fault firing, beacon observation,
+# self-fencing) and gate the heartbeat exactly like mock.begin_round
+_GANG_SCRIPT = """\
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from xgboost_tpu.parallel import gang
+hb = os.environ.get("XGBTPU_HEARTBEAT_DIR")
+rank = os.environ.get("XGBTPU_WORKER_ID", "0")
+for v in range({rounds}):
+    beat = gang.on_round(v)
+    if hb and beat:
+        with open(os.path.join(hb, f"hb-{{rank}}"), "w") as f:
+            f.write(str(v))
+    time.sleep({sleep})
+gang.mark_done()
+sys.exit(0)
+"""
+
+
+def _gang_worker(tmp_path, rounds, sleep=0.15):
+    script = tmp_path / "gang_worker.py"
+    script.write_text(
+        _GANG_SCRIPT.format(repo=REPO, rounds=rounds, sleep=sleep))
+    return [sys.executable, str(script)]
+
+
+def test_plan_degrade_prefers_device_halving():
+    """The re-plan ladder: halve local devices down the PR 12
+    invariance ladder first, then shed workers one at a time, floored
+    at min_workers; a minimal gang cannot degrade."""
+    from xgboost_tpu.parallel.launch import plan_degrade
+    assert plan_degrade(4, 4) == (4, 2)
+    assert plan_degrade(4, 2) == (4, 1)
+    assert plan_degrade(4, 1) == (3, 1)
+    assert plan_degrade(2, None) == (1, None)
+    assert plan_degrade(1, None) is None
+    assert plan_degrade(1, 1) is None
+    assert plan_degrade(2, None, min_workers=2) is None
+
+
+def test_coordinator_state_roundtrip_and_corruption(tmp_path, capfd):
+    """Coordinator snapshots carry the ring's CRC discipline: a clean
+    roundtrip restores the plan + roster, and a flipped byte makes the
+    snapshot unusable (fresh start), never a silently-wrong adoption."""
+    from xgboost_tpu.parallel.launch import _read_state, _write_state
+    p = str(tmp_path / "coord-state.json")
+    st = {"full_n": 2, "cur_n": 1, "cur_devices": None, "degraded": True,
+          "trial": 3, "hb_dir": None, "gang_dir": str(tmp_path),
+          "workers": [{"rank": 0, "pid": 12345}]}
+    _write_state(p, st, "pid777")
+    got = _read_state(p)
+    assert got == dict(st, holder="pid777")
+    raw = bytearray(open(p, "rb").read())
+    raw[10] ^= 0x20
+    open(p, "wb").write(bytes(raw))
+    capfd.readouterr()
+    assert _read_state(p) is None
+    assert "unusable" in capfd.readouterr().err
+
+
+def test_host_loss_degrades_gang_and_grow_back_restores(
+        tmp_path, monkeypatch, capfd):
+    """ISSUE 17 tentpole (1) at the launcher seam: a permanent host
+    loss (worker exits HOST_LOSS_RC + tombstone) immediately re-plans
+    the gang one worker smaller; while degraded, a ``grow`` file in
+    the gang dir re-expands to full size on the next restart."""
+    import threading
+
+    from xgboost_tpu.parallel.launch import launch_local
+    from xgboost_tpu.profiling import reliability_metrics
+    monkeypatch.setenv("XGBTPU_FAULTS", "host_loss@t0.r0.v1.")
+    gang_dir = tmp_path / "gang"
+    gang_dir.mkdir()
+    rm = reliability_metrics()
+    base = {k: rm.launch_restarts.value(k)
+            for k in ("host_loss", "growback")}
+    base_grow = rm.launch_growbacks.value
+
+    stop = threading.Event()
+
+    def grow_when_degraded():
+        state = gang_dir / "coord-state.json"
+        while not stop.is_set():
+            try:
+                if b'"degraded": true' in state.read_bytes():
+                    (gang_dir / "grow").touch()
+                    return
+            except OSError:
+                pass
+            time.sleep(0.05)
+
+    t = threading.Thread(target=grow_when_degraded, daemon=True)
+    t.start()
+    try:
+        rc = launch_local(2, _gang_worker(tmp_path, rounds=12, sleep=0.2),
+                          keepalive=True, standalone=True,
+                          degrade_after=3, restart_backoff_sec=0.05,
+                          gang_dir=str(gang_dir))
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert rc == 0
+    assert rm.launch_restarts.value("host_loss") == base["host_loss"] + 1
+    assert rm.launch_restarts.value("growback") == base["growback"] + 1
+    assert rm.launch_growbacks.value == base_grow + 1
+    # the final attempt ran at FULL size, not degraded
+    assert rm.launch_mesh_size.value == 2
+    assert rm.launch_degraded.value == 0
+    err = capfd.readouterr().err
+    assert "[gang] HOST LOSS" in err
+    assert "[launch] DEGRADE: re-planning 2x1 -> 1x1 (host loss" in err
+    assert "[launch] GROW-BACK" in err
+
+
+def test_partition_window_self_fence_and_restart(
+        tmp_path, monkeypatch, capfd):
+    """ISSUE 17 tentpole (3): a worker partitioned from the
+    coordinator beacon past gang_partition_sec self-fences (exit
+    FENCE_RC, no further writes) and keepalive restarts the gang —
+    reason ``fence``, counter-verified on the launcher side."""
+    from xgboost_tpu.parallel.launch import launch_local
+    from xgboost_tpu.profiling import reliability_metrics
+    monkeypatch.setenv("XGBTPU_FAULTS", "partition=30.0@t0.r0.v1.")
+    rm = reliability_metrics()
+    base = rm.launch_restarts.value("fence")
+    rc = launch_local(1, _gang_worker(tmp_path, rounds=10, sleep=0.15),
+                      keepalive=True, standalone=True,
+                      gang_partition_sec=0.45,
+                      restart_backoff_sec=0.05)
+    assert rc == 0
+    assert rm.launch_restarts.value("fence") == base + 1
+    err = capfd.readouterr().err
+    assert "[gang] partition window" in err
+    assert "[gang] FENCED" in err
+    assert "reason fence" in err
+
+
+def test_coordinator_restart_adopts_live_gang(tmp_path, capfd):
+    """ISSUE 17 tentpole (2): a coordinator restarted against a prior
+    holder's state snapshot whose workers are all still alive ADOPTS
+    them (no respawn), observes their clean exits via done markers,
+    and removes the snapshot on success."""
+    from xgboost_tpu.parallel.launch import _write_state, launch_local
+    gang_dir = tmp_path / "gang"
+    gang_dir.mkdir()
+    state = str(gang_dir / "coord-state.json")
+    sentinel = tmp_path / "respawned"
+    # double-fork: the orphaned worker must NOT be our child, or its
+    # exit leaves a zombie that os.kill(pid, 0) still sees as alive —
+    # in the real failover it reparents to init and is reaped
+    spawner = subprocess.run(
+        [sys.executable, "-c",
+         "import subprocess, sys\n"
+         "p = subprocess.Popen([sys.executable, '-c', "
+         "\"import os, sys, time; time.sleep(1.2); \"\n"
+         "    \"open(os.path.join(sys.argv[1], 'done-0'), 'w')"
+         ".write('done')\", sys.argv[1]])\n"
+         "print(p.pid)\n",
+         str(gang_dir)],
+        capture_output=True, text=True, timeout=30)
+    assert spawner.returncode == 0, spawner.stderr
+    pid = int(spawner.stdout.strip())
+    try:
+        _write_state(state, {
+            "full_n": 1, "cur_n": 1, "cur_devices": None,
+            "degraded": False, "trial": 2, "hb_dir": None,
+            "gang_dir": str(gang_dir),
+            "workers": [{"rank": 0, "pid": pid}]}, "pid-dead")
+        rc = launch_local(
+            1, [sys.executable, "-c",
+                f"open({str(sentinel)!r}, 'w').write('x')"],
+            standalone=True, gang_dir=str(gang_dir), state_path=state)
+    finally:
+        try:
+            os.kill(pid, 9)
+        except OSError:
+            pass
+    assert rc == 0
+    assert not sentinel.exists(), "adoption must not respawn the gang"
+    assert not os.path.exists(state), "success removes the snapshot"
+    assert "[launch] re-adopting live gang" in capfd.readouterr().err
+
+
+def test_superseded_coordinator_exits_without_reaping(tmp_path, capfd):
+    """The single-holder lease: a coordinator that sees the state-file
+    holder change under it exits COORD_FENCED_RC WITHOUT touching the
+    workers — they belong to the new holder now."""
+    import threading
+
+    from xgboost_tpu.parallel.launch import (COORD_FENCED_RC,
+                                             _pid_alive, _read_state,
+                                             _write_state, launch_local)
+    gang_dir = tmp_path / "gang"
+    gang_dir.mkdir()
+    state = str(gang_dir / "coord-state.json")
+    out = {}
+
+    def run():
+        out["rc"] = launch_local(
+            1, [sys.executable, "-c", "import time; time.sleep(60)"],
+            standalone=True, gang_dir=str(gang_dir), state_path=state)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    st = None
+    while time.monotonic() < deadline:
+        st = _read_state(state)
+        if st and st.get("workers"):
+            break
+        time.sleep(0.05)
+    assert st and st.get("workers"), "launcher never snapshotted"
+    pid = int(st["workers"][0]["pid"])
+    _write_state(state, st, "intruder")
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert out["rc"] == COORD_FENCED_RC
+    try:
+        # the worker was NOT reaped: the new holder owns it
+        assert _pid_alive(pid)
+    finally:
+        try:
+            os.kill(pid, 9)
+        except OSError:
+            pass
+    assert "[launch] coordinator fenced" in capfd.readouterr().err
+
+
+def test_stale_lease_wait_blocks_until_renewals_stop(tmp_path):
+    """Standby-side half of the lease: _wait_for_stale_lease must NOT
+    return while the primary keeps bumping the state-file mtime, and
+    must return shortly after the bumps stop."""
+    import threading
+
+    from xgboost_tpu.parallel.launch import _wait_for_stale_lease
+    state = tmp_path / "coord-state.json"
+    state.write_text("{}")
+
+    def renew():
+        for _ in range(8):
+            os.utime(state, None)
+            time.sleep(0.15)
+
+    t = threading.Thread(target=renew, daemon=True)
+    t0 = time.monotonic()
+    t.start()
+    _wait_for_stale_lease(str(state), 0.6, poll=0.05)
+    elapsed = time.monotonic() - t0
+    t.join()
+    assert elapsed > 1.2, "took over while the primary was renewing"
+    assert elapsed < 8.0
 
 
 def test_two_process_full_booster_training(tmp_path):

@@ -142,6 +142,58 @@ def test_checkpoint_warm_start_bit_identity():
         set_mesh(None)
 
 
+@pytest.mark.skipif(not mesh_available(2), reason="needs >= 2 devices")
+@pytest.mark.parametrize("n_head,n_tail",
+                         [(4, 2), (4, 1), (2, 1), (2, 4)])
+def test_mesh_size_change_resume_parity(n_head, n_tail, tmp_path):
+    """The degraded-mesh resume oracle (RECOVERY.md degraded-mode
+    matrix): train 4 of 6 rounds on an ``n_head``-device mesh, write a
+    segment-boundary RING checkpoint (the real cli ring writer), then
+    resume the remaining rounds in a FRESH booster on an ``n_tail``-
+    device mesh through the real ring loader — rows re-sharded to the
+    new size.  Bytes AND eval-line text must equal the uninterrupted
+    single-device run for every shrink the degrade ladder can take
+    (4->2, 4->1, 2->1) and for the grow-back direction (2->4)."""
+    if not mesh_available(max(n_head, n_tail)):
+        pytest.skip(f"needs >= {max(n_head, n_tail)} devices")
+    from xgboost_tpu.cli import _load_checkpoint, _save_checkpoint
+    want_model, want_lines = _train_fused(1, n_rounds=6, k=2)
+
+    X, y = make_data()
+    Xe, ye = make_data(n=256, seed=7)
+    ck = str(tmp_path / "ring")
+    lines = []
+
+    set_mesh(data_parallel_mesh(n_head))
+    try:
+        d = xgb.DMatrix(X, label=y)
+        de = xgb.DMatrix(Xe, label=ye)
+        head = Booster(PARAMS, cache=[d, de])
+        head.update_many(d, 0, 4, evals=[(d, "train"), (de, "eval")],
+                         eval_callback=lambda i, m: lines.append(m),
+                         rounds_per_dispatch=2)
+        _save_checkpoint(ck, head, 4)
+    finally:
+        set_mesh(None)
+
+    set_mesh(data_parallel_mesh(n_tail))
+    try:
+        d2 = xgb.DMatrix(X, label=y)
+        de2 = xgb.DMatrix(Xe, label=ye)
+        tail = Booster(PARAMS, cache=[d2, de2])
+        tail, start = _load_checkpoint(ck, tail, PARAMS)
+        assert start == 4
+        assert tail.gbtree.num_boosted_rounds == 4
+        tail.update_many(d2, start, 6 - start,
+                         evals=[(d2, "train"), (de2, "eval")],
+                         eval_callback=lambda i, m: lines.append(m),
+                         rounds_per_dispatch=2)
+        assert lines == want_lines
+        assert bytes(tail.save_raw()) == want_model
+    finally:
+        set_mesh(None)
+
+
 def test_fused_fallback_is_loud(monkeypatch):
     """A multi-round run that cannot fuse must say so: the
     xgbtpu_train_fused_fallback_total counter gains the first blocking

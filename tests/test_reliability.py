@@ -172,7 +172,64 @@ def test_quarantine_moves_file_aside(tmp_path):
     assert q2 != q and os.path.exists(q2)
 
 
+def test_quarantine_fsyncs_parent_dir(tmp_path, monkeypatch):
+    """ISSUE 17 satellite: the quarantine rename must be made DURABLE
+    (directory fsync) — a crash right after quarantining a corrupt
+    ring member must not resurrect it into the ring on reboot."""
+    fsynced = []
+    real = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (fsynced.append(fd), real(fd))[1])
+    p = tmp_path / "z.model"
+    p.write_bytes(b"junk")
+    q = quarantine(str(p))
+    assert not p.exists() and os.path.exists(q)
+    assert fsynced, "quarantine rename was not fsynced"
+
+
 # ------------------------------------------------- fault registry itself
+def test_fault_spec_errors_fail_loud_and_arm_nothing(tmp_path):
+    """ISSUE 17 satellite: every malformed spec raises the typed
+    FaultSpecError at ARM time, emits a ``faults.invalid_spec`` obs
+    event, and arms NOTHING — including when the bad entry TRAILS
+    valid ones (two-phase parse), so a chaos run with a typo'd spec
+    dies at startup instead of passing with untested faults."""
+    from xgboost_tpu.obs import events
+    log = str(tmp_path / "obs.jsonl")
+    events.configure_log(log)
+    bad_specs = (
+        "bogus_kind@ckpt",          # unknown kind
+        "torn_write=abc@ckpt",      # non-numeric arg
+        "torn_write=128@ckpt*0",    # times < 1
+        "bit_flip@ckpt*zz",         # non-integer times
+        "=3@x",                     # empty kind
+        "   ;  ;",                  # spec arms nothing
+        "torn_write=128@ckpt;bogus@x",  # trailing typo: NOTHING armed
+    )
+    try:
+        for bad in bad_specs:
+            with pytest.raises(faults.FaultSpecError):
+                faults.install_spec(bad)
+            assert not faults.active(), bad
+    finally:
+        events.configure_log(None)
+    recs = [json.loads(line) for line in open(log)]
+    names = [r["name"] for r in recs if r.get("kind") == "event"]
+    assert names.count("faults.invalid_spec") == len(bad_specs)
+
+
+def test_gang_fault_kinds_fire_at_coordinate():
+    """The gang seam: ``host_loss``/``partition`` arm from a spec and
+    fire exactly at their ``t<trial>.r<rank>.v<version>.`` coordinate,
+    once each."""
+    faults.install_spec("host_loss@t0.r0.v2.;partition=3.5@t0.r1.v4.")
+    assert faults.gang_fault("t0.r0.v1.") == []
+    assert faults.gang_fault("t1.r0.v2.") == []  # other trial: no fire
+    assert faults.gang_fault("t0.r0.v2.") == [("host_loss", None)]
+    assert faults.gang_fault("t0.r0.v2.") == []  # fired once, disarmed
+    assert faults.gang_fault("t0.r1.v4.") == [("partition", 3.5)]
+
+
 def test_fault_spec_parsing():
     faults.install_spec("torn_write=128@ckpt-000003;slow_read=0.01#3;enospc")
     assert faults.active()

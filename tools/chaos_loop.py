@@ -102,6 +102,40 @@ the mesh-fused scan), both verified fallback-free via the obs event
 log (``train.fused_fallback`` must never appear).  Emits
 ``TRAIN_CHAOS.json``.
 
+``--train --degrade`` additionally runs the ELASTIC DEGRADED-MESH
+cells (RECOVERY.md degraded-mode matrix) against the real CLI under
+the gang launcher:
+
+- ``host_loss_growback`` — a permanent host death mid-run
+  (``host_loss`` gang fault) forces an immediate re-plan at half the
+  device count; once degraded, the driver touches the ``grow`` signal
+  (a replacement registered) and the launcher re-expands to full size
+  at the next segment boundary.  Asserted: the finished model is
+  BIT-identical to an uninterrupted run (PR 12 mesh-size invariance is
+  the oracle) and the ``gang.host_loss`` / ``launch.degrade`` /
+  ``launch.growback`` events all fired.
+- ``coord_sigkill_adopt`` — SIGKILL the COORDINATOR mid-restart (right
+  after a worker death triggered a gang restart); a replacement
+  launcher started on the same ``--state-path`` re-ADOPTS the live
+  workers (``launch.adopt``) instead of orphaning or re-spawning them,
+  and the job finishes bit-identical with no leaked pids.
+- ``partition_fence`` — a ``partition`` window straddling the ring
+  writes: the worker self-fences (``gang.fence``, rc 143) once the
+  coordinator beacon is stale past ``--gang-partition-sec``, the gang
+  restarts and resumes from the ring.  A watcher thread samples every
+  checkpoint-ring member THROUGHOUT; the split-brain assertion is that
+  every observed member CRC-verifies (atomic_write: no torn reads) and
+  every version slot ever observed holds exactly ONE payload hash
+  across all attempts — one attempt lineage, no second writer.
+
+Cell results merge into the same ``TRAIN_CHAOS.json`` under
+``degrade``.  ``--runs 0`` skips the stall cells (degrade cells only).
+
+``--selftest`` runs the fast, subprocess-free logic checks (partition
+clock, degrade ladder, coordinator-state roundtrip, fail-loud fault
+parsing, ring-lineage scanner) and prints ``selftest: OK`` — wired as
+a tier-1 test (tests/test_chaos_selftest.py).
+
 ``--fleet --slow`` arms ``slow_replica`` (a wedged-but-alive replica:
 every predict sleeps, lease and /healthz stay green) instead of kills:
 the router's latency-aware ejection must take the replica out of
@@ -208,6 +242,8 @@ def train_stall_mode(args) -> int:
               "watchdog_kills": 0, "restarts": 0,
               "bit_identical": 0, "mismatches": 0,
               "fused_fallbacks": 0, "run_log": []}
+    if args.runs == 0:
+        cells = []  # --runs 0: degrade cells only (see --degrade)
     for cell, extra, launch_extra in cells:
         # uninterrupted reference per cell (checkpointing ON: identical
         # code path; the mesh cell's params change the model)
@@ -282,6 +318,9 @@ def train_stall_mode(args) -> int:
                   f"watchdog kill(s), {entry['restarts']} restart(s), "
                   f"{entry['fused_fallbacks']} fused fallback(s))",
                   file=sys.stderr)
+    degrade_ok = True
+    if args.degrade:
+        degrade_ok = degrade_cells(args, work, repo, report)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
@@ -291,10 +330,357 @@ def train_stall_mode(args) -> int:
           f"kills / {report['restarts']} restarts "
           f"({report['fused_fallbacks']} fused fallbacks) -> {args.out}",
           file=sys.stderr)
-    ok = (report["mismatches"] == 0 and report["watchdog_kills"] >= 1
-          and report["restarts"] >= report["watchdog_kills"]
-          and report["fused_fallbacks"] == 0)
-    return 0 if ok else 1
+    ok = (report["mismatches"] == 0 and report["fused_fallbacks"] == 0
+          and (args.runs == 0
+               or (report["watchdog_kills"] >= 1
+                   and report["restarts"] >= report["watchdog_kills"])))
+    return 0 if (ok and degrade_ok) else 1
+
+
+def _ckpt_lineage_violations(lineage) -> list:
+    """Ring slots observed with MORE than one distinct payload hash —
+    the split-brain witness: a resumed attempt rewriting a version slot
+    must reproduce the identical bytes (deterministic recovery), so a
+    second hash means a second, diverged writer touched the ring."""
+    return sorted(name for name, hashes in lineage.items()
+                  if len(hashes) > 1)
+
+
+def degrade_cells(args, work, repo, report) -> bool:
+    """The elastic degraded-mesh chaos cells (see module docstring,
+    ``--train --degrade``): host-loss degrade + grow-back, coordinator
+    SIGKILL + re-adoption, and a partition self-fence with the ring
+    split-brain assertion.  Results land in ``report['degrade']``."""
+    import hashlib
+    import re
+    import signal
+    import subprocess
+    import threading
+
+    from xgboost_tpu.cli import main as cli_main
+    from xgboost_tpu.reliability.integrity import (read_file,
+                                                   verify_model_bytes)
+
+    data = os.path.join(work, "train.libsvm")
+    mesh = ["dsplit=row", "hist_precision=fixed"]
+
+    def common(rounds):
+        return [f"data={data}", "task=train", f"num_round={rounds}",
+                "silent=2", "objective=binary:logistic", "max_depth=3",
+                "eta=0.5", "max_bin=16", "rounds_per_dispatch=2"]
+
+    def reference(tag, rounds, extra):
+        # uninterrupted single-device reference: PR 12 mesh-size
+        # invariance (dsplit=row + hist_precision=fixed) makes it the
+        # oracle for EVERY size the elastic gang passes through
+        ref_model = os.path.join(work, f"ref_{tag}.model")
+        rc = cli_main(common(rounds) + extra + [
+            f"model_out={ref_model}",
+            f"checkpoint_dir={os.path.join(work, f'ck_ref_{tag}')}"])
+        if rc != 0:
+            raise RuntimeError(f"degrade reference {tag} failed rc={rc}")
+        return _state(ref_model)
+
+    def launch(tag, rounds, extra, launch_extra, env_extra,
+               watch=None, timeout=420.0):
+        """Run one launcher attempt; ``watch(proc, paths)`` is polled
+        every 100ms for driver-side chaos (grow signals, SIGKILLs)."""
+        out = os.path.join(work, f"{tag}.model")
+        obs_log = os.path.join(work, f"obs_{tag}.jsonl")
+        gang_dir = os.path.join(work, f"gang_{tag}")
+        os.makedirs(gang_dir, exist_ok=True)
+        ck = os.path.join(work, f"ck_{tag}")
+        worker = [sys.executable, "-m", "xgboost_tpu", *common(rounds),
+                  *extra, f"model_out={out}", f"checkpoint_dir={ck}"]
+        cmd = [sys.executable, "-m", "xgboost_tpu.launch", "-n", "1",
+               "--standalone", "--keepalive",
+               "--restart-backoff-sec", "0.2",
+               "--gang-dir", gang_dir, *launch_extra, "--", *worker]
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XGBTPU_OBS_LOG=obs_log, XGBTPU_OBS_PHASES="0",
+                   **env_extra)
+        log = open(os.path.join(work, f"{tag}.log"), "ab")
+        paths = {"out": out, "obs": obs_log, "gang_dir": gang_dir,
+                 "ck": ck, "log": log.name,
+                 "state": os.path.join(gang_dir, "coord-state.json")}
+        p = subprocess.Popen(cmd, cwd=repo, env=env,
+                             stdout=log, stderr=log)
+        deadline = time.perf_counter() + timeout
+        try:
+            while p.poll() is None and time.perf_counter() < deadline:
+                if watch is not None:
+                    stop = watch(p, paths)
+                    if stop:
+                        break
+                time.sleep(0.1)
+            if p.poll() is None and (watch is None
+                                     or time.perf_counter() >= deadline):
+                p.kill()
+        finally:
+            p.wait()
+            log.close()
+        return p.returncode, paths
+
+    results = {}
+    ok = True
+
+    # ---- cell 1: permanent host loss mid-run -> immediate degrade to
+    # half the devices, then a grow-back once the driver (standing in
+    # for a replacement host registering) touches the grow signal
+    ref = reference("degrade", args.rounds, mesh)
+    state_seen = {"grown": False}
+
+    def grow_when_degraded(p, paths):
+        if not state_seen["grown"]:
+            try:
+                with open(paths["state"], errors="replace") as f:
+                    if '"degraded": true' in f.read():
+                        open(os.path.join(paths["gang_dir"], "grow"),
+                             "w").close()
+                        state_seen["grown"] = True
+                        print("[chaos-degrade] degraded snapshot seen; "
+                              "touched grow signal", file=sys.stderr)
+            except OSError:
+                pass
+        return False
+
+    rc, paths = launch(
+        "d1", args.rounds, mesh,
+        ["--local-devices", "2", "--degrade-after", "3"],
+        {"XGBTPU_FAULTS": "host_loss@t0.r0.v2."},
+        watch=grow_when_degraded)
+    cell = {"rc": rc,
+            "grow_signal_sent": state_seen["grown"],
+            "host_loss_events": _scan_obs_events(paths["obs"],
+                                                 "gang.host_loss"),
+            "degrades": _scan_obs_events(paths["obs"], "launch.degrade"),
+            "growbacks": _scan_obs_events(paths["obs"],
+                                          "launch.growback"),
+            "bit_identical": (rc == 0
+                              and _states_equal(ref,
+                                                _state(paths["out"])))}
+    cell["pass"] = bool(rc == 0 and cell["bit_identical"]
+                        and cell["host_loss_events"] >= 1
+                        and cell["degrades"] >= 1
+                        and cell["growbacks"] >= 1)
+    results["host_loss_growback"] = cell
+    ok &= cell["pass"]
+    print(f"[chaos-degrade] host_loss_growback: {cell}", file=sys.stderr)
+
+    # ---- cell 2: coordinator SIGKILL mid-restart; the replacement
+    # launcher on the same --state-path re-adopts the live gang
+    ref2 = reference("adopt", args.rounds, [])
+    killed = {"at": None}
+
+    def kill_mid_restart(p, paths):
+        # wait for the worker-death restart (the mock die fires at v3),
+        # give trial 1 a second to be live mid-compile, then SIGKILL
+        # the coordinator — the gang must survive it
+        if killed["at"] is None and \
+                _scan_obs_events(paths["obs"], "launch.restart") >= 1:
+            killed["at"] = time.perf_counter() + 1.0
+        if killed["at"] is not None \
+                and time.perf_counter() >= killed["at"]:
+            p.send_signal(signal.SIGKILL)
+            print("[chaos-degrade] SIGKILLed coordinator mid-restart",
+                  file=sys.stderr)
+            return True
+        return False
+
+    state_path = os.path.join(work, "d2-coord-state.json")
+    rc, paths = launch("d2", args.rounds, ["mock=die:3,0,0"],
+                       ["--state-path", state_path], {},
+                       watch=kill_mid_restart)
+    orphans = []
+    try:
+        with open(state_path, errors="replace") as f:
+            orphans = [int(m) for m in
+                       re.findall(r'"pid": (\d+)', f.read())]
+    except OSError:
+        pass
+    # the replacement coordinator: same state path, same command
+    rc2, paths2 = launch("d2", args.rounds, ["mock=die:3,0,0"],
+                         ["--state-path", state_path], {})
+    time.sleep(1.0)  # adopted workers exit right after their done mark
+    leaked = [pid for pid in orphans
+              if os.path.exists(f"/proc/{pid}")]
+    cell = {"coordinator_sigkilled": rc != 0 or killed["at"] is not None,
+            "worker_pids_at_kill": orphans, "relaunch_rc": rc2,
+            "adoptions": _scan_obs_events(paths2["obs"], "launch.adopt"),
+            "leaked_pids": leaked,
+            "bit_identical": (rc2 == 0
+                              and _states_equal(ref2,
+                                                _state(paths2["out"])))}
+    cell["pass"] = bool(rc2 == 0 and cell["bit_identical"]
+                        and cell["adoptions"] >= 1
+                        and cell["coordinator_sigkilled"]
+                        and not leaked)
+    results["coord_sigkill_adopt"] = cell
+    ok &= cell["pass"]
+    print(f"[chaos-degrade] coord_sigkill_adopt: {cell}", file=sys.stderr)
+
+    # ---- cell 3: partition window straddling the ring writes -> the
+    # worker self-fences, the gang restarts and resumes from the ring;
+    # a watcher samples every ring member throughout for the
+    # split-brain assertion (CRC + one-lineage-per-slot)
+    fence_rounds = 400  # ~8ms/segment: the window must outlast beacons
+    ref3 = reference("fence", fence_rounds, [])
+    lineage = {}
+    crc_failures = []
+    stop_watch = threading.Event()
+    ck3 = os.path.join(work, "ck_d3")
+
+    def ring_watcher():
+        while not stop_watch.is_set():
+            try:
+                names = [n for n in os.listdir(ck3)
+                         if re.fullmatch(r"ckpt-\d{6}\.model", n)]
+            except OSError:
+                names = []
+            for n in names:
+                try:
+                    payload = verify_model_bytes(
+                        read_file(os.path.join(ck3, n)), name=n)
+                except OSError:
+                    continue  # rotated away mid-read: not an observation
+                except ValueError:
+                    crc_failures.append(n)
+                    continue
+                lineage.setdefault(n, set()).add(
+                    hashlib.sha256(payload).hexdigest())
+            time.sleep(0.01)
+
+    wt = threading.Thread(target=ring_watcher)
+    wt.start()
+    try:
+        rc, paths = launch(
+            "d3", fence_rounds, [],
+            ["--gang-partition-sec", "0.5"],
+            {"XGBTPU_FAULTS": "partition=20.0@t0.r0.v6."})
+    finally:
+        stop_watch.set()
+        wt.join(10.0)
+    cell = {"rc": rc,
+            "fences": _scan_obs_events(paths["obs"], "gang.fence"),
+            "partition_windows": _scan_obs_events(paths["obs"],
+                                                  "gang.partition"),
+            "restarts": _scan_obs_events(paths["obs"], "launch.restart"),
+            "ring_slots_observed": len(lineage),
+            "ring_crc_failures": sorted(set(crc_failures)),
+            "ring_lineage_violations":
+                _ckpt_lineage_violations(lineage),
+            "bit_identical": (rc == 0
+                              and _states_equal(ref3,
+                                                _state(paths["out"])))}
+    cell["pass"] = bool(rc == 0 and cell["bit_identical"]
+                        and cell["fences"] >= 1
+                        and cell["restarts"] >= 1
+                        and cell["ring_slots_observed"] >= 2
+                        and not cell["ring_crc_failures"]
+                        and not cell["ring_lineage_violations"])
+    results["partition_fence"] = cell
+    ok &= cell["pass"]
+    print(f"[chaos-degrade] partition_fence: {cell}", file=sys.stderr)
+
+    report["degrade"] = results
+    report["degrade_pass"] = bool(ok)
+    return bool(ok)
+
+
+def selftest() -> int:
+    """Fast, subprocess-free logic checks for the elastic-gang pieces
+    (wired as a tier-1 test; the heavyweight cells above are the real
+    chaos proof).  Prints ``selftest: OK`` on success."""
+    from xgboost_tpu.parallel.gang import PartitionClock
+    from xgboost_tpu.parallel.launch import (_read_state, _write_state,
+                                             plan_degrade)
+    from xgboost_tpu.reliability import faults
+
+    # -- partition clock: fence past threshold, heal on fresh beacon
+    now = [0.0]
+    clk = PartitionClock(partition_sec=0.5, monotonic=lambda: now[0])
+    assert clk.observe(1.0) == "ok"          # grace starts
+    now[0] = 0.1
+    assert clk.observe(2.0) == "ok"          # beacon advanced
+    clk.open_window(5.0)
+    now[0] = 0.3
+    assert clk.observe(3.0) == "partitioned"  # read dropped
+    now[0] = 0.7
+    assert clk.observe(4.0) == "fence"       # stale past 0.5s
+    # heal path: window expired, a fresh beacon mtime lands
+    now[0] = 6.0
+    assert clk.observe(5.0) == "ok"
+    # no spurious fence: boundaries every 50ms, beacon only every 200ms
+    clk2 = PartitionClock(partition_sec=0.5, monotonic=lambda: now[0])
+    mtime = 0.0
+    for i in range(40):
+        now[0] = 10.0 + i * 0.05
+        if i % 4 == 0:
+            mtime += 1.0
+        assert clk2.observe(mtime) == "ok", f"spurious fence at {i}"
+    # fencing disabled: stale forever still never fences
+    clk3 = PartitionClock(partition_sec=0.0, monotonic=lambda: now[0])
+    clk3.observe(1.0)
+    now[0] += 1000.0
+    assert clk3.observe(1.0) == "ok"
+
+    # -- degrade ladder: devices halve first, then workers shed, and
+    # min_workers floors the ladder
+    assert plan_degrade(4, 4) == (4, 2)
+    assert plan_degrade(4, 2) == (4, 1)
+    assert plan_degrade(4, 1) == (3, 1)
+    assert plan_degrade(2, None) == (1, None)
+    assert plan_degrade(1, None) is None
+    assert plan_degrade(2, None, min_workers=2) is None
+
+    # -- coordinator-state snapshot: roundtrip + corrupt rejection
+    with tempfile.TemporaryDirectory() as d:
+        sp = os.path.join(d, "state.json")
+        st = {"full_n": 2, "cur_n": 1, "degraded": True, "trial": 3,
+              "workers": [{"rank": 0, "pid": 123}]}
+        _write_state(sp, st, "pid42")
+        got = _read_state(sp)
+        assert got is not None and got["holder"] == "pid42"
+        assert got["cur_n"] == 1 and got["degraded"] is True
+        with open(sp, "r+b") as f:   # flip a byte: CRC must reject it
+            f.seek(5)
+            b = f.read(1)
+            f.seek(5)
+            f.write(bytes([b[0] ^ 0xFF]))
+        assert _read_state(sp) is None
+
+    # -- fail-loud fault specs: arm-time typed errors, nothing armed
+    for bad in ("bogus_kind@ckpt", "torn_write=abc@ckpt",
+                "torn_write=128@ckpt*0", "bit_flip@ckpt*zz", "=3@x"):
+        try:
+            faults.install_spec(bad)
+        except faults.FaultSpecError:
+            pass
+        else:
+            raise AssertionError(f"spec {bad!r} did not fail loud")
+        finally:
+            faults.clear_faults()
+    # a trailing typo arms NOTHING (two-phase parse)
+    try:
+        faults.install_spec("torn_write=128@ckpt;bogus@x")
+    except faults.FaultSpecError:
+        pass
+    assert not faults.gang_fault("t0.r0.v0.")
+    faults.install_spec("host_loss@t0.r0.v2.;partition=3.5@t0.r0.v4.")
+    assert faults.gang_fault("t0.r0.v2.") == [("host_loss", None)]
+    assert faults.gang_fault("t0.r0.v4.") == [("partition", 3.5)]
+    assert not faults.gang_fault("t1.r0.v2.")  # trial-scoped
+    faults.clear_faults()
+
+    # -- ring-lineage scanner: one hash per slot is clean, two is a
+    # split brain
+    clean = {"ckpt-000002.model": {"aa"}, "ckpt-000004.model": {"bb"}}
+    split = {"ckpt-000002.model": {"aa", "cc"}}
+    assert _ckpt_lineage_violations(clean) == []
+    assert _ckpt_lineage_violations(split) == ["ckpt-000002.model"]
+
+    print("selftest: OK")
+    return 0
 
 
 def fleet_mode(args) -> int:
@@ -1582,6 +1968,19 @@ def main(argv=None) -> int:
                     help="--train: in-process device count for the "
                          "fused_mesh cell (dsplit=row over an "
                          "N-virtual-CPU-device mesh)")
+    ap.add_argument("--degrade", action="store_true",
+                    help="--train addition: run the elastic degraded-"
+                         "mesh cells (host_loss degrade + grow-back, "
+                         "coordinator SIGKILL + re-adoption, partition "
+                         "self-fence with the ring split-brain "
+                         "assertion); merged into TRAIN_CHAOS.json "
+                         "under 'degrade'.  --runs 0 skips the stall "
+                         "cells and runs only these.")
+    ap.add_argument("--selftest", action="store_true",
+                    help="fast subprocess-free logic checks (partition "
+                         "clock, degrade ladder, state roundtrip, "
+                         "fail-loud fault parsing, lineage scanner); "
+                         "prints 'selftest: OK'")
     ap.add_argument("--pipeline", action="store_true",
                     help="continuous-training mode: SIGKILL/corrupt "
                          "the train→gate→publish→reload boundary under "
@@ -1614,6 +2013,11 @@ def main(argv=None) -> int:
                          "converges to its snapshotted plan "
                          "(PLACER_CHAOS.json; see module docstring)")
     args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if args.degrade and not args.train:
+        ap.error("--degrade composes with --train "
+                 "(use --train --degrade, optionally --runs 0)")
     if args.out is None:
         args.out = ("STREAM_CHAOS.json" if args.stream
                     else "PLACER_CHAOS.json" if args.placer

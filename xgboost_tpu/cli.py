@@ -309,7 +309,7 @@ class BoostLearnTask:
             # sub-second detection (RECOVERY.md).  Covers real failures
             # (bad input, OOM, metric errors), not just the injector.
             try:
-                return self._dispatch()
+                return self._dispatch_marked()
             except SystemExit:
                 raise
             except BaseException:
@@ -317,7 +317,18 @@ class BoostLearnTask:
                 traceback.print_exc()
                 sys.stderr.flush()
                 os._exit(41)
-        return self._dispatch()
+        return self._dispatch_marked()
+
+    def _dispatch_marked(self) -> int:
+        """Dispatch, then touch the gang ``done-<rank>`` marker on
+        success — a re-adopting coordinator cannot ``wait()`` a worker
+        it did not spawn, so clean exit must be visible on disk
+        (parallel/gang.py)."""
+        rc = self._dispatch()
+        if rc == 0:
+            from xgboost_tpu.parallel import gang
+            gang.mark_done()
+        return rc
 
     def _setup_obs(self) -> None:
         """Arm the observability layer (OBSERVABILITY.md) from params:
@@ -497,6 +508,17 @@ class BoostLearnTask:
                     and (last_i + 1) % self.save_period == 0:
                 self._save(bst, last_i)
             if self.checkpoint_dir and self.rank == 0:
+                from xgboost_tpu.obs import event
+                from xgboost_tpu.parallel import gang
+                if gang.fenced():
+                    # split-brain interlock (RECOVERY.md): a fenced
+                    # worker must never race the ring with its
+                    # replacement.  The fence path exits the process at
+                    # the round boundary, so this gate is a second
+                    # lock on the same door — kept because the ring is
+                    # the one artifact two writers must never share
+                    event("ckpt.fenced_skip", version=last_i + 1)
+                    return
                 _save_checkpoint(self.checkpoint_dir, bst, last_i + 1)
 
         bst.update_many(data, start_round, self.num_round - start_round,

@@ -389,13 +389,35 @@ class ReliabilityMetrics:
         self.launch_restarts = LabeledCounter(
             "xgbtpu_launch_restarts_total", "reason",
             "whole-gang restarts by the launcher, by reason "
-            "(death = nonzero worker exit, stall = watchdog kill)")
+            "(death = nonzero worker exit, stall = watchdog kill, "
+            "fence = worker self-fenced, host_loss = permanent host "
+            "death, growback = re-expansion to full size)")
+        # elastic degraded-mesh recovery (RECOVERY.md degraded-mode
+        # matrix): gang size re-planning, partition fencing, grow-back
+        self.launch_mesh_size = Gauge(
+            "xgbtpu_launch_mesh_size",
+            "devices the launcher's current gang plan schedules "
+            "(workers x local devices); drops on degrade, restores on "
+            "grow-back")
+        self.launch_degraded = Gauge(
+            "xgbtpu_launch_degraded",
+            "1 while the gang runs below its full planned size")
+        self.launch_fences = Counter(
+            "xgbtpu_launch_fence_total",
+            "workers that self-fenced after the coordinator was "
+            "unreachable past gang_partition_sec")
+        self.launch_growbacks = Counter(
+            "xgbtpu_launch_growbacks_total",
+            "degraded gangs re-expanded to full size after a "
+            "replacement worker registered")
         self._all = (self.integrity_failures, self.ring_fallbacks,
                      self.quarantines, self.poisoned_reloads,
                      self.shed_requests, self.faults_injected,
                      self.drain_seconds, self.deadline_rejected,
                      self.deadline_dropped, self.launch_worker_deaths,
-                     self.launch_restarts)
+                     self.launch_restarts, self.launch_mesh_size,
+                     self.launch_degraded, self.launch_fences,
+                     self.launch_growbacks)
         registry().register("reliability", self.render)
 
     def render(self) -> str:

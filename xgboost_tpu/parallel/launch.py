@@ -35,14 +35,16 @@ byte-identical models (rank 0 saves, like the reference).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import shutil
+import signal
 import socket
 import subprocess
 import sys
 import tempfile
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 COORD_ENV = "XGBTPU_COORD"
 NWORKER_ENV = "XGBTPU_NUM_WORKER"
@@ -52,6 +54,14 @@ TRIAL_ENV = "XGBTPU_NUM_TRIAL"
 #: exit code launch_local returns for an unrecovered stall (no
 #: keepalive / restart budget exhausted) — worker rcs are small
 STALL_RC = 142
+#: exit code for a coordinator superseded by a standby takeover: it
+#: must stop supervising (the new holder owns the workers) and report
+#: neither success nor worker failure
+COORD_FENCED_RC = 145
+#: grow-back signal file in the gang dir: a replacement worker (or the
+#: operator) touches it to ask a DEGRADED gang to re-expand to full
+#: size at the next segment boundary (= checkpoint resume point)
+GROW_SIGNAL = "grow"
 
 
 def init_worker(local_device_count: Optional[int] = None) -> bool:
@@ -170,12 +180,136 @@ def _latest_heartbeat(hb_dir: str) -> Optional[float]:
     return latest
 
 
+def plan_degrade(n: int, local_devices: Optional[int],
+                 min_workers: int = 1
+                 ) -> Optional[Tuple[int, Optional[int]]]:
+    """The largest viable smaller gang plan, or None when already
+    minimal.  Device counts HALVE (the mesh-size-invariance family PR 12
+    proved bit-identical is the power-of-two ladder 8/4/2/1); worker
+    counts step down by one (the rank/nparts modulo row split re-shards
+    at any count).  Pure — the chaos selftest drives it directly."""
+    if local_devices is not None and local_devices > 1:
+        return n, local_devices // 2
+    if n > max(1, min_workers):
+        return n - 1, local_devices
+    return None
+
+
+def _write_state(state_path: str, state: dict, holder: str) -> None:
+    """Snapshot coordinator state (gang roster, attempt counter, plan)
+    atomically with the standard CRC footer — the same discipline as a
+    ring member, because a restarted coordinator re-adopting live
+    workers off a torn snapshot would be its own split brain."""
+    from xgboost_tpu.reliability.integrity import add_footer, atomic_write
+    payload = json.dumps(dict(state, holder=holder),
+                         sort_keys=True).encode()
+    atomic_write(state_path, add_footer(payload))
+
+
+def _read_state(state_path: str) -> Optional[dict]:
+    """Load + CRC-verify a coordinator snapshot; None when missing or
+    unusable (a corrupt snapshot means fresh-start, not crash)."""
+    from xgboost_tpu.reliability.integrity import (read_file,
+                                                   verify_model_bytes)
+    try:
+        raw = read_file(state_path)
+    except OSError:
+        return None
+    try:
+        payload = verify_model_bytes(raw, name=state_path, warn=False)
+        return json.loads(payload.decode())
+    except ValueError as e:
+        from xgboost_tpu.obs import event
+        event("launch.state_corrupt", path=state_path, error=str(e))
+        print(f"[launch] coordinator state {state_path} unusable "
+              f"({e}); starting fresh", file=sys.stderr)
+        return None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # alive, just not ours to signal
+
+
+def _reap_pids(pids: List[int], grace: float = 3.0) -> None:
+    """The :func:`_reap` discipline for ADOPTED workers — non-children
+    this coordinator cannot ``wait()``: SIGTERM, poll for death within
+    the grace, then SIGKILL."""
+    for pid in pids:
+        if _pid_alive(pid):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass  # died between the check and the signal
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        if not any(_pid_alive(p) for p in pids):
+            return
+        time.sleep(0.1)
+    for pid in pids:
+        if _pid_alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+    while any(_pid_alive(p) for p in pids):
+        time.sleep(0.05)
+
+
+def _touch(path: str) -> None:
+    """mtime-bump a beacon file (created on first touch); never raises
+    — a beacon failure must not kill a healthy coordinator loop."""
+    try:
+        os.utime(path, None)
+    except OSError:
+        try:
+            with open(path, "a"):  # xgtpu: disable=XGT003 — liveness beacon
+                pass
+        except OSError as e:
+            from xgboost_tpu.obs.metrics import swallowed_error
+            swallowed_error("parallel.launch.beacon", e, emit_event=False)
+
+
+def _wait_for_stale_lease(state_path: str, lease_sec: float,
+                          poll: float = 0.25) -> None:
+    """Standby-coordinator wait (the placer's single-holder-lease idea
+    on a file): the primary renews its lease by mtime-bumping the state
+    snapshot every poll tick; block until that stops for ``lease_sec``
+    (or the file never appears for that long) — then the primary is
+    dead and this process may take over."""
+    last_mtime: Optional[float] = None
+    last_change = time.monotonic()
+    while True:
+        try:
+            m = os.stat(state_path).st_mtime
+        except OSError:
+            m = None
+        if m is not None and m != last_mtime:
+            last_mtime = m
+            last_change = time.monotonic()
+        elif time.monotonic() - last_change > lease_sec:
+            return
+        time.sleep(poll)
+
+
 def launch_local(n: int, cmd: List[str], keepalive: bool = False,
                  local_devices: Optional[int] = None,
                  max_restarts: int = 10,
                  watchdog_stall_sec: float = 0.0,
                  restart_backoff_sec: float = 0.5,
-                 standalone: bool = False) -> int:
+                 standalone: bool = False,
+                 degrade_after: int = 0,
+                 min_workers: int = 1,
+                 gang_partition_sec: float = 0.0,
+                 gang_dir: Optional[str] = None,
+                 state_path: Optional[str] = None,
+                 standby: bool = False,
+                 coord_lease_sec: float = 10.0) -> int:
     """Spawn ``n`` local worker processes running ``cmd`` (the
     rabit_demo.py submitter).
 
@@ -205,41 +339,190 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
     the launcher contributes only keepalive + the stall watchdog —
     process supervision for jobs (or containers) where the
     ``jax.distributed`` mesh path is unavailable.
+
+    **Elastic degraded-mesh recovery** (RECOVERY.md degraded-mode
+    matrix) arms when any of the gang knobs is set:
+
+    - ``degrade_after > 0``: after that many consecutive failed
+      attempts at the current size — or IMMEDIATELY on a permanent
+      host loss (worker rc ``HOST_LOSS_RC`` / ``lost-<rank>``
+      tombstone) — the gang is re-planned at the largest viable
+      smaller size (:func:`plan_degrade`) and resumes from the last
+      segment-boundary ring member; mesh-size invariance (PR 12) makes
+      the finished model bit-identical to an uninterrupted run.
+    - While degraded, a ``grow`` file appearing in the gang dir (a
+      replacement worker registered) re-expands the gang to full size
+      at the next segment boundary — the restart resumes from the last
+      boundary's checkpoint, which IS the boundary.
+    - ``gang_partition_sec > 0``: the launcher maintains a ``coord``
+      beacon in the gang dir; a worker that cannot see it advance for
+      that long self-fences (``parallel/gang.py``) — it stops writing
+      checkpoints/heartbeats and dies ``FENCE_RC``, so a healed
+      partition can never put two writers on the ring.
+    - ``state_path`` (default ``<gang_dir>/coord-state.json``):
+      coordinator state (gang roster + pids, attempt counter, current
+      plan) snapshots via ``atomic_write``+CRC at every attempt
+      boundary; a SIGKILL'd coordinator restarted with the same path
+      RE-ADOPTS the live workers (pid-polled, clean exits visible via
+      ``done-<rank>`` markers) instead of orphaning them.
+    - ``standby=True``: warm-standby coordinator (the placer's
+      single-holder-lease pattern on a file): block until the
+      primary's lease — the state-file mtime it bumps every poll tick
+      — goes stale for ``coord_lease_sec``, then take over and adopt.
+      A superseded primary notices the holder change and exits
+      ``COORD_FENCED_RC`` without touching the workers.
     """
     from xgboost_tpu.obs import event
+    from xgboost_tpu.parallel import gang as gangmod
     from xgboost_tpu.profiling import reliability_metrics
     from xgboost_tpu.reliability.deadline import backoff_delay
+
+    rm = reliability_metrics()
+    gang_on = bool(degrade_after or gang_partition_sec > 0 or gang_dir
+                   or state_path or standby)
+    own_gang_dir = False
+    if gang_on:
+        if gang_dir is None:
+            gang_dir = tempfile.mkdtemp(prefix="xgbtpu_gang_")
+            own_gang_dir = True
+        else:
+            os.makedirs(gang_dir, exist_ok=True)
+        if state_path is None:
+            state_path = os.path.join(gang_dir, "coord-state.json")
+    holder = f"pid{os.getpid()}"
+
+    if standby:
+        print(f"[launch] standby coordinator: watching {state_path} "
+              f"(lease {coord_lease_sec}s)", file=sys.stderr)
+        _wait_for_stale_lease(state_path, coord_lease_sec)
+        event("launch.standby_takeover", state_path=state_path,
+              holder=holder)
+        print(f"[launch] standby takeover: lease stale, {holder} is "
+              "now the coordinator", file=sys.stderr)
 
     hb_root = None
     if watchdog_stall_sec > 0:
         hb_root = tempfile.mkdtemp(prefix="xgbtpu_hb_")
+
+    # the gang plan: full size is what the caller asked for; the
+    # current size shrinks on degrade and restores on grow-back
+    cur_n, cur_devices = n, local_devices
+    degraded = False
+    trial = 0
+    fails_at_size = 0
+
+    # coordinator failover: a previous holder's snapshot with every
+    # worker pid still alive means ADOPT, not respawn — a SIGKILL'd
+    # coordinator must not orphan (or needlessly kill) a healthy gang
+    adopt_pids: Optional[Dict[int, int]] = None
+    adopt_hb_dir: Optional[str] = None
+    if gang_on and os.path.exists(state_path):
+        st = _read_state(state_path)
+        if st and int(st.get("full_n", -1)) == n:
+            trial = int(st.get("trial", 0))
+            cur_n = int(st.get("cur_n", n))
+            cd = st.get("cur_devices")
+            cur_devices = int(cd) if cd is not None else None
+            degraded = bool(st.get("degraded"))
+            workers = {int(w["rank"]): int(w["pid"])
+                       for w in st.get("workers", [])}
+            live = {r: p for r, p in workers.items() if _pid_alive(p)}
+            done_marks = {r for r in workers
+                          if os.path.exists(os.path.join(
+                              gang_dir, f"done-{r}"))}
+            if workers and all(r in live or r in done_marks
+                               for r in workers):
+                adopt_pids = workers
+                adopt_hb_dir = st.get("hb_dir")
+            elif live:
+                # partial gang: the stragglers are doomed (their gang
+                # is broken) — reap them and restart normally
+                _reap_pids(list(live.values()))
+
     try:
-        trial = 0
         while True:
-            coord = f"localhost:{free_port()}"
+            rm.launch_mesh_size.set(cur_n * (cur_devices or 1))
+            rm.launch_degraded.set(1 if degraded else 0)
             t_attempt = time.perf_counter()  # duration anchor (XGT006)
-            hb_dir = None
-            if hb_root is not None:
-                # fresh beacon dir per attempt: a stale heartbeat from
-                # the previous trial must not vouch for this one
-                hb_dir = os.path.join(hb_root, f"t{trial}")
-                os.makedirs(hb_dir, exist_ok=True)
+            adopted = adopt_pids is not None
+            grow_path = (os.path.join(gang_dir, GROW_SIGNAL)
+                         if gang_on else None)
 
-            def spawn(rank: int) -> subprocess.Popen:
-                env = dict(os.environ)
-                if not standalone:
-                    env[COORD_ENV] = coord
-                env[NWORKER_ENV] = str(n)
-                env[RANK_ENV] = str(rank)
-                env[TRIAL_ENV] = str(trial)
-                if hb_dir is not None:
-                    env["XGBTPU_HEARTBEAT_DIR"] = hb_dir
-                if local_devices is not None:
-                    env["XGBTPU_LOCAL_DEVICES"] = str(local_devices)
-                return subprocess.Popen(cmd, env=env)
+            if adopted:
+                live_pids = dict(adopt_pids)
+                adopt_pids = None
+                hb_dir = adopt_hb_dir
+                event("launch.adopt", trial=trial,
+                      workers=sorted(live_pids.values()))
+                print(f"[launch] re-adopting live gang "
+                      f"{sorted(live_pids.items())} (trial {trial})",
+                      file=sys.stderr)
+                _write_state(state_path, {
+                    "full_n": n, "cur_n": cur_n,
+                    "cur_devices": cur_devices, "degraded": degraded,
+                    "trial": trial, "hb_dir": hb_dir,
+                    "gang_dir": gang_dir,
+                    "workers": [{"rank": r, "pid": p}
+                                for r, p in live_pids.items()],
+                }, holder)
+                procs = []
+            else:
+                coord = f"localhost:{free_port()}"
+                hb_dir = None
+                if hb_root is not None:
+                    # fresh beacon dir per attempt: a stale heartbeat
+                    # from the previous trial must not vouch for this
+                    hb_dir = os.path.join(hb_root, f"t{trial}")
+                    os.makedirs(hb_dir, exist_ok=True)
+                if gang_on:
+                    # stale completion markers must not vouch for the
+                    # ranks of THIS attempt
+                    for name in os.listdir(gang_dir):
+                        if name.startswith("done-"):
+                            try:
+                                os.remove(os.path.join(gang_dir, name))
+                            except OSError:
+                                pass  # racing a concurrent cleaner
+                    _touch(os.path.join(gang_dir, gangmod.BEACON_NAME))
 
-            procs: List[Optional[subprocess.Popen]] = [spawn(r)
-                                                       for r in range(n)]
+                def spawn(rank: int) -> subprocess.Popen:
+                    env = dict(os.environ)
+                    if not standalone:
+                        env[COORD_ENV] = coord
+                    env[NWORKER_ENV] = str(cur_n)
+                    env[RANK_ENV] = str(rank)
+                    env[TRIAL_ENV] = str(trial)
+                    if hb_dir is not None:
+                        env["XGBTPU_HEARTBEAT_DIR"] = hb_dir
+                    if cur_devices is not None:
+                        env["XGBTPU_LOCAL_DEVICES"] = str(cur_devices)
+                    if gang_on:
+                        env[gangmod.GANG_DIR_ENV] = gang_dir
+                        if gang_partition_sec > 0:
+                            env[gangmod.PARTITION_SEC_ENV] = str(
+                                gang_partition_sec)
+                        if degraded:
+                            env[gangmod.DEGRADED_ENV] = "1"
+                        else:
+                            env.pop(gangmod.DEGRADED_ENV, None)
+                    return subprocess.Popen(cmd, env=env)
+
+                procs = [spawn(r) for r in range(cur_n)]
+                live_pids = {}
+                if gang_on:
+                    # attempt-boundary snapshot: everything a restarted
+                    # coordinator needs to re-adopt this exact gang
+                    _write_state(state_path, {
+                        "full_n": n, "cur_n": cur_n,
+                        "cur_devices": cur_devices,
+                        "degraded": degraded, "trial": trial,
+                        "hb_dir": hb_dir, "gang_dir": gang_dir,
+                        "workers": [{"rank": r, "pid": p.pid}
+                                    for r, p in enumerate(procs)],
+                    }, holder)
+
+            procs_left: List[Optional[subprocess.Popen]] = list(procs)
+            done_ranks: set = set()
             # stall clock: progress = the newest heartbeat mtime CHANGED
             # since the last poll (mtimes are wall-clock, so they are
             # only ever compared with each other; the silence DURATION
@@ -247,25 +530,72 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
             last_progress = time.monotonic()
             last_hb_seen: Optional[float] = None
             failed_rc = None
+            host_lost = False
             stalled = False
-            while any(p is not None for p in procs) and failed_rc is None:
+            grow = False
+            superseded = False
+            tick = 0
+
+            def gang_alive() -> bool:
+                if adopted:
+                    return any(r not in done_ranks for r in live_pids)
+                return any(p is not None for p in procs_left)
+
+            while gang_alive() and failed_rc is None:
                 time.sleep(0.2)
-                for r, p in enumerate(procs):
-                    if p is None or p.poll() is None:
-                        continue
-                    if p.returncode == 0:
-                        procs[r] = None
-                    else:
-                        failed_rc = p.returncode
-                        reliability_metrics().launch_worker_deaths.inc()
-                        event("launch.worker_death", rank=r,
-                              rc=p.returncode, trial=trial)
-                        print(f"[launch] worker {r} died "
-                              f"(rc={p.returncode}, trial {trial})",
-                              file=sys.stderr)
+                tick += 1
+                if gang_on:
+                    # coordinator liveness beacon (workers fence off
+                    # its staleness) + lease renewal for any standby
+                    _touch(os.path.join(gang_dir, gangmod.BEACON_NAME))
+                    _touch(state_path)
+                    if tick % 10 == 0:
+                        st = _read_state(state_path)
+                        if st is not None and st.get("holder") != holder:
+                            superseded = True
+                            break
+                    if degraded and os.path.exists(grow_path):
+                        grow = True
+                        try:
+                            os.remove(grow_path)
+                        except OSError:
+                            pass  # signal already consumed either way
                         break
+                if adopted:
+                    for r, pid in live_pids.items():
+                        if r in done_ranks or _pid_alive(pid):
+                            continue
+                        if os.path.exists(os.path.join(
+                                gang_dir, f"done-{r}")):
+                            done_ranks.add(r)
+                            continue
+                        failed_rc = 1  # unwaitable: rc unknowable
+                        rm.launch_worker_deaths.inc()
+                        event("launch.worker_death", rank=r, rc=None,
+                              trial=trial, adopted=True)
+                        print(f"[launch] adopted worker {r} (pid {pid})"
+                              f" died without a done marker "
+                              f"(trial {trial})", file=sys.stderr)
+                        break
+                else:
+                    for r, p in enumerate(procs_left):
+                        if p is None or p.poll() is None:
+                            continue
+                        if p.returncode == 0:
+                            procs_left[r] = None
+                        else:
+                            failed_rc = p.returncode
+                            if p.returncode == gangmod.HOST_LOSS_RC:
+                                host_lost = True
+                            rm.launch_worker_deaths.inc()
+                            event("launch.worker_death", rank=r,
+                                  rc=p.returncode, trial=trial)
+                            print(f"[launch] worker {r} died "
+                                  f"(rc={p.returncode}, trial {trial})",
+                                  file=sys.stderr)
+                            break
                 if (failed_rc is None and hb_dir is not None
-                        and any(p is not None for p in procs)):
+                        and gang_alive()):
                     # stall watchdog: progress = a NEW heartbeat from
                     # any rank since the last poll (spawn time until
                     # the first one lands — startup counts against the
@@ -285,17 +615,98 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
                               f", trial {trial}); killing the gang",
                               file=sys.stderr)
                         break
-            if failed_rc is None and not stalled:
+
+            if superseded:
+                # a standby took the lease: the workers are THEIRS now
+                # — touching them (or the beacon, or the state file)
+                # from here would be exactly the two-coordinator race
+                # the single-holder lease exists to prevent
+                event("launch.coord_fenced", trial=trial, holder=holder)
+                print(f"[launch] coordinator fenced: state holder "
+                      f"changed under {holder}; exiting "
+                      f"rc={COORD_FENCED_RC} without touching the "
+                      "gang", file=sys.stderr)
+                return COORD_FENCED_RC
+            if failed_rc is None and not stalled and not grow:
+                if gang_on:
+                    try:
+                        os.remove(state_path)  # job done: nothing to adopt
+                    except OSError:
+                        pass  # never written / already gone
                 return 0
             t_detect = time.perf_counter()
-            _reap(procs)
+            if adopted:
+                _reap_pids([p for r, p in live_pids.items()
+                            if r not in done_ranks])
+            else:
+                _reap(procs_left)
+
+            if grow:
+                trial += 1
+                prev = (cur_n, cur_devices)
+                cur_n, cur_devices = n, local_devices
+                degraded = False
+                fails_at_size = 0
+                rm.launch_growbacks.inc()
+                rm.launch_restarts.inc("growback")
+                event("launch.growback", trial=trial,
+                      from_size=prev[0] * (prev[1] or 1),
+                      to_size=cur_n * (cur_devices or 1))
+                print(f"[launch] GROW-BACK: replacement registered; "
+                      f"re-expanding {prev[0]}x{prev[1] or 1} -> "
+                      f"{cur_n}x{cur_devices or 1} from the last "
+                      f"segment boundary (trial {trial})",
+                      file=sys.stderr)
+                continue  # a healthy gang was cut: restart immediately
+
             if not keepalive or trial >= max_restarts:
                 return STALL_RC if stalled else failed_rc
             trial += 1
-            reason = "stall" if stalled else "death"
-            reliability_metrics().launch_restarts.inc(reason)
+            fails_at_size += 1
+            tombs = gangmod.live_tombstones(gang_dir) if gang_on else []
+            reason = ("stall" if stalled
+                      else "host_loss" if host_lost or tombs
+                      else "fence" if failed_rc == gangmod.FENCE_RC
+                      else "death")
+            rm.launch_restarts.inc(reason)
             event("launch.restart", reason=reason, trial=trial,
                   attempt_sec=round(t_detect - t_attempt, 2))
+
+            # degraded-mode re-plan: immediately on permanent host
+            # loss, or after degrade_after consecutive same-size
+            # failures; the resume point is the last segment-boundary
+            # ring member, and PR 12's mesh-size invariance keeps the
+            # finished model bit-identical at the smaller size
+            if gang_on and (host_lost or tombs
+                            or (degrade_after > 0
+                                and fails_at_size >= degrade_after)):
+                plan = plan_degrade(cur_n, cur_devices, min_workers)
+                if plan is not None:
+                    prev = (cur_n, cur_devices)
+                    cur_n, cur_devices = plan
+                    degraded = True
+                    fails_at_size = 0
+                    event("launch.degrade", trial=trial,
+                          reason=("host_loss" if host_lost or tombs
+                                  else "restart_budget"),
+                          from_size=prev[0] * (prev[1] or 1),
+                          to_size=cur_n * (cur_devices or 1))
+                    print(f"[launch] DEGRADE: re-planning "
+                          f"{prev[0]}x{prev[1] or 1} -> "
+                          f"{cur_n}x{cur_devices or 1} "
+                          f"({'host loss' if host_lost or tombs else 'restart budget'}"
+                          f", trial {trial}); resuming from the last "
+                          "segment boundary", file=sys.stderr)
+                    for t in tombs:  # consumed: no longer scheduled
+                        try:
+                            os.remove(os.path.join(gang_dir, f"lost-{t}"))
+                        except OSError:
+                            pass
+                else:
+                    print("[launch] cannot degrade below "
+                          f"{cur_n}x{cur_devices or 1}; retrying at "
+                          "the same size", file=sys.stderr)
+
             # jittered exponential backoff between trials (the shared
             # reliability helper): a crash loop (bad input, wedged
             # device) must not hot-spin the host it is supposed to be
@@ -304,8 +715,8 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
                                   cap=30.0)
             # recovery-cost accounting (RECOVERY.md): attempt wall time
             # up to detection, plus the reap (SIGTERM the survivors)
-            print(f"[launch] restarting all {n} workers, trial {trial} "
-                  f"(reason {reason}, attempt ran "
+            print(f"[launch] restarting all {cur_n} workers, trial "
+                  f"{trial} (reason {reason}, attempt ran "
                   f"{t_detect - t_attempt:.2f}s, "
                   f"reap {time.perf_counter() - t_detect:.2f}s, "
                   f"backoff {delay:.2f}s)",
@@ -314,6 +725,8 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = False,
     finally:
         if hb_root is not None:
             shutil.rmtree(hb_root, ignore_errors=True)
+        if own_gang_dir:
+            shutil.rmtree(gang_dir, ignore_errors=True)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -340,6 +753,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--standalone", action="store_true",
                     help="supervise without distributed rendezvous "
                          "(no XGBTPU_COORD): keepalive + watchdog only")
+    ap.add_argument("--degrade-after", type=int, default=0,
+                    help="after this many consecutive failed attempts "
+                         "at the current size (or immediately on a "
+                         "permanent host loss), re-plan the gang at "
+                         "the largest viable smaller size and resume "
+                         "from the last segment boundary (0 = off)")
+    ap.add_argument("--min-workers", type=int, default=1,
+                    help="never degrade below this many workers")
+    ap.add_argument("--gang-partition-sec", type=float, default=0.0,
+                    help="workers self-fence (stop checkpoint/beacon "
+                         "writes, exit 143) after this long without a "
+                         "fresh coordinator beacon (0 = off)")
+    ap.add_argument("--gang-dir", default=None,
+                    help="shared gang-protocol directory (beacon, "
+                         "tombstones, grow signal); default: a fresh "
+                         "tempdir, removed on exit")
+    ap.add_argument("--state-path", default=None,
+                    help="coordinator-state snapshot (CRC-footered "
+                         "JSON, atomic): restart with the same path to "
+                         "re-adopt a live gang after coordinator death "
+                         "(default: <gang-dir>/coord-state.json)")
+    ap.add_argument("--standby", action="store_true",
+                    help="warm-standby coordinator: block until the "
+                         "primary's lease on --state-path goes stale, "
+                         "then take over and adopt its workers")
+    ap.add_argument("--coord-lease-sec", type=float, default=10.0,
+                    help="coordinator lease: the primary bumps the "
+                         "state-file mtime every poll tick; a standby "
+                         "takes over after this long without a bump")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if args.cmd and args.cmd[0] == "--":
@@ -351,7 +793,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         max_restarts=args.max_restarts,
                         watchdog_stall_sec=args.watchdog_stall_sec,
                         restart_backoff_sec=args.restart_backoff_sec,
-                        standalone=args.standalone)
+                        standalone=args.standalone,
+                        degrade_after=args.degrade_after,
+                        min_workers=args.min_workers,
+                        gang_partition_sec=args.gang_partition_sec,
+                        gang_dir=args.gang_dir,
+                        state_path=args.state_path,
+                        standby=args.standby,
+                        coord_lease_sec=args.coord_lease_sec)
 
 
 if __name__ == "__main__":

@@ -27,7 +27,12 @@ Two fault KINDS share the coordinate space:
 The round boundary (:func:`begin_round`) doubles as the LIVENESS
 beacon: when the launcher exports ``XGBTPU_HEARTBEAT_DIR``, every rank
 touches its per-rank heartbeat file there at each round, so "all ranks
-stopped advancing" is observable from outside the gang.
+stopped advancing" is observable from outside the gang.  It is also the
+GANG-protocol checkpoint (``parallel/gang.py``): the ``partition`` and
+``host_loss`` chaos kinds (``reliability/faults.py``) fire here, a
+worker inside an open partition window suppresses its heartbeat (the
+message is "dropped"), and one unreachable past
+``XGBTPU_GANG_PARTITION_SEC`` self-fences before this function returns.
 
 Deterministic recovery holds because per-iteration seeding is derived by
 ``fold_in(seed, iteration)`` (the reference forces seed_per_iteration in
@@ -158,9 +163,14 @@ def begin_round(version: int) -> None:
     # the round boundary doubles as the observability round marker:
     # collective stats (obs/comm.py) and discrete events correlate by
     # this version, the report_stats "version" role — AND as the
-    # per-rank liveness beacon the stall watchdog reads
+    # per-rank liveness beacon the stall watchdog reads.  The gang
+    # protocol hook runs FIRST: it may kill the process (host_loss /
+    # self-fence) or veto the beacon (open partition window drops
+    # worker->coordinator messages too)
     from xgboost_tpu.obs import comm, trace
-    touch_heartbeat(version)
+    from xgboost_tpu.parallel import gang
+    if gang.on_round(version):
+        touch_heartbeat(version)
     comm.begin_round(version)
     trace.set_round(version)
     if _injector is not None:

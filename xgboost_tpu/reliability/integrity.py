@@ -195,6 +195,15 @@ def quarantine(path: Union[str, os.PathLike]) -> str:
         dest = f"{path}.corrupt{i}"
         i += 1
     os.replace(path, dest)
+    # same dir-fsync discipline as atomic_write: the rename must be
+    # durable before the next ring scan trusts it — a crash straight
+    # after an unfsynced quarantine can resurrect the corrupt member
+    # under its original name and send the scan into the same bytes
+    dfd = os.open(os.path.dirname(os.path.abspath(dest)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
     from xgboost_tpu.obs import event
     from xgboost_tpu.profiling import reliability_metrics
     reliability_metrics().quarantines.inc()
