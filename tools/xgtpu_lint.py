@@ -2,7 +2,8 @@
 """xgtpu-lint CLI — thin wrapper over ``python -m xgboost_tpu.analysis``.
 
 Usage:
-    tools/xgtpu_lint.py [paths...] [--json] [--rules XGT003,XGT011]
+    tools/xgtpu_lint.py [paths...] [--json | --sarif]
+                        [--rules XGT003,XGT011]
                         [--baseline PATH | --no-baseline]
                         [--write-baseline] [--list-rules] [-v]
                         [--changed [REF]] [--write-contracts]
@@ -10,9 +11,11 @@ Usage:
 
 ``--changed [REF]`` (default HEAD) is the fast pre-commit loop: only
 findings anchored in files changed vs. REF are reported (cross-file
-contract rules XGT008-XGT011 still collect facts repo-wide).
-``--write-contracts`` regenerates the committed ANALYSIS_CONTRACTS.json
-inventory (routes, metric families, knobs, lock edges).
+contract rules XGT008-XGT012/XGT016/XGT017 still collect facts
+repo-wide).  ``--write-contracts`` regenerates the committed
+ANALYSIS_CONTRACTS.json inventory (routes, metric families, knobs,
+lock edges, exit codes, event names).  ``--sarif`` emits SARIF 2.1.0
+(one run per rule code) for editor/CI ingestion.
 
 Exit codes: 0 clean, 1 findings, 2 usage/internal error.  Rule catalog
 and fix recipes: ANALYSIS.md.

@@ -6,13 +6,15 @@ Exit-code contract (what CI keys off):
   1  findings
   2  usage / internal error
 
-Cross-file contract rules (XGT008-XGT012, analysis/contracts.py) run
-alongside the per-file rules by default: facts are collected from the
-whole repo (package + ``tools/``) regardless of which subset of paths
-was scanned, because a contract is only checkable whole.  ``--changed
-[REF]`` narrows REPORTING to files touched vs. a git ref (the fast
-pre-commit loop); ``--write-contracts`` regenerates the committed
-``ANALYSIS_CONTRACTS.json`` inventory.
+Cross-file contract rules (XGT008-XGT012 + XGT016/XGT017,
+analysis/contracts.py) run alongside the per-file rules by default:
+facts are collected from the whole repo (package + ``tools/``)
+regardless of which subset of paths was scanned, because a contract is
+only checkable whole.  ``--changed [REF]`` narrows REPORTING to files
+touched vs. a git ref (the fast pre-commit loop); ``--write-contracts``
+regenerates the committed ``ANALYSIS_CONTRACTS.json`` inventory;
+``--sarif`` renders the report as SARIF 2.1.0 (one run per rule code)
+for editor/CI ingestion — same findings, same exit contract.
 
 ``tools/xgtpu_lint.py`` is a thin wrapper around this module.
 """
@@ -64,6 +66,72 @@ def _changed_files(ref: str) -> Set[str]:
     return out
 
 
+def _rule_catalog():
+    """code -> (short name, one-line description), per-file + contract."""
+    cat = {}
+    for r in all_rules():
+        doc = (r.__class__.__doc__ or "").strip().splitlines()[0]
+        cat[r.code] = (r.name, doc)
+    for code, (name, doc) in CONTRACT_RULE_DOCS.items():
+        cat[code] = (name, doc)
+    return cat
+
+
+def _sarif_report(result) -> dict:
+    """SARIF 2.1.0 view of one lint result: one run per rule code that
+    produced findings (so per-family triage tools group naturally), or
+    a single empty-results run carrying the full rule catalog when the
+    tree is clean (consumers distinguish "ran clean" from "didn't
+    run").  Artifact URIs are repo-root-relative; columns are 1-based
+    per the SARIF region contract."""
+    root = repo_root()
+    cat = _rule_catalog()
+
+    def rel(p: str) -> str:
+        try:
+            r = os.path.relpath(os.path.abspath(p), root)
+        except ValueError:
+            r = p
+        return r.replace(os.sep, "/")
+
+    def rule_obj(code: str) -> dict:
+        name, doc = cat.get(code, (code.lower(), ""))
+        return {"id": code, "name": name,
+                "shortDescription": {"text": doc}}
+
+    def run_obj(rules: List[dict], results: List[dict]) -> dict:
+        return {"tool": {"driver": {"name": "xgtpu-lint",
+                                    "informationUri":
+                                        "https://github.com/xgboost-tpu",
+                                    "rules": rules}},
+                "results": results}
+
+    by_rule: dict = {}
+    for f in result.findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    runs = []
+    for code in sorted(by_rule):
+        results = []
+        for f in by_rule[code]:
+            region: dict = {"startLine": max(f.line, 1)}
+            if f.col:
+                region["startColumn"] = f.col + 1
+            if f.snippet:
+                region["snippet"] = {"text": f.snippet}
+            results.append({
+                "ruleId": code,
+                "level": "warning",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": rel(f.path)},
+                    "region": region}}]})
+        runs.append(run_obj([rule_obj(code)], results))
+    if not runs:
+        runs = [run_obj([rule_obj(c) for c in sorted(cat)], [])]
+    return {"$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+            "version": "2.1.0", "runs": runs}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m xgboost_tpu.analysis",
@@ -74,6 +142,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(default: the xgboost_tpu package)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report on stdout")
+    ap.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 report on stdout (one run per "
+                         "rule code; exit contract unchanged)")
     ap.add_argument("--rules", default=None, metavar="XGT00x[,..]",
                     help="run only the named rules")
     ap.add_argument("--list-rules", action="store_true",
@@ -88,7 +159,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "baseline file and exit 0")
     ap.add_argument("--no-contracts", action="store_true",
                     help="skip the cross-file contract rules "
-                         "(XGT008-XGT012)")
+                         "(XGT008-XGT012, XGT016, XGT017)")
     ap.add_argument("--write-contracts", action="store_true",
                     help="regenerate ANALYSIS_CONTRACTS.json from the "
                          "extracted route/metric/knob/lock inventories "
@@ -104,6 +175,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         args = ap.parse_args(argv)
     except SystemExit as e:
         return 0 if e.code in (0, None) else 2
+
+    if args.as_json and args.sarif:
+        print("xgtpu-lint: --json and --sarif are two renderings of "
+              "one report — pick one", file=sys.stderr)
+        return 2
 
     contract_codes = set(CONTRACT_CODES)
     try:
@@ -146,7 +222,9 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"({len(inv['http_routes'])} routes, "
               f"{len(inv['metric_families'])} metric families, "
               f"{len(inv['env_knobs'])} env knobs, "
-              f"{len(inv['lock_edges'])} lock edges)", file=sys.stderr)
+              f"{len(inv['lock_edges'])} lock edges, "
+              f"{len(inv['exit_codes'])} exit codes, "
+              f"{len(inv['events'])} events)", file=sys.stderr)
         return 0
 
     anchor_filter = None
@@ -251,6 +329,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.as_json:
         print(json.dumps(result.to_json(), indent=2))
+    elif args.sarif:
+        print(json.dumps(_sarif_report(result), indent=2))
     else:
         core.render_report(result, verbose=args.verbose)
     return 0 if result.clean else 1

@@ -35,7 +35,22 @@ enforced cross-file invariants with a two-phase engine:
      explicit ``timeout`` — a timeout-less client blocked on a wedged
      peer is a latent hang, exactly the stall failure the deadline /
      watchdog / ejection machinery exists to bound (RELIABILITY.md
-     stall matrix).
+     stall matrix);
+   - **XGT016** exit-code registry (v3): process exit codes are
+     defined ONCE, in ``reliability/rc.py`` (``*_RC`` constants), and
+     referenced symbolically everywhere — a ``sys.exit``/``os._exit``
+     with a bare int literal (other than the POSIX-generic 0/1/2), a
+     comparison of a returncode against a literal matching a
+     registered code, or a ``*_RC`` constant defined outside the
+     registry are findings.  The launcher keys recovery decisions off
+     these codes (``HOST_LOSS_RC`` -> re-plan, ``FENCE_RC`` ->
+     readmit), so a drifted literal silently reroutes recovery;
+   - **XGT017** obs event-name drift (v3): every event name emitted
+     via ``trace.event(...)``/``self._event(...)`` (and literal
+     ``{"kind": "event"}`` dicts handed to ``events.emit``) must
+     appear in OBSERVABILITY.md's "Event inventory" table and vice
+     versa — the chaos selftests and obs_report grep these names, so
+     an undocumented rename breaks tooling without failing a test.
 
 The extracted inventories are committed as ``ANALYSIS_CONTRACTS.json``
 (:meth:`ContractEngine.inventory`) so reviewers see contract diffs in
@@ -61,7 +76,8 @@ from xgboost_tpu.analysis.core import (FileContext, Finding, Suppressions,
                                        iter_py_files, terminal_name)
 
 #: the cross-file rule codes this engine owns
-CONTRACT_CODES = ("XGT008", "XGT009", "XGT010", "XGT011", "XGT012")
+CONTRACT_CODES = ("XGT008", "XGT009", "XGT010", "XGT011", "XGT012",
+                  "XGT016", "XGT017")
 
 #: one-line catalog entries (``--list-rules``)
 CONTRACT_RULE_DOCS = {
@@ -79,12 +95,28 @@ CONTRACT_RULE_DOCS = {
     "XGT012": ("http-timeout-discipline",
                "every outbound HTTP call (urlopen / HTTPConnection) "
                "must pass an explicit timeout"),
+    "XGT016": ("exit-code-registry",
+               "*_RC exit codes defined once in reliability/rc.py, "
+               "referenced symbolically (no magic exit literals)"),
+    "XGT017": ("event-name-drift",
+               "trace.event names in code <-> OBSERVABILITY.md event "
+               "inventory table"),
 }
 
 _HTTP_METHODS = frozenset({"GET", "POST", "PUT", "DELETE", "HEAD",
                            "PATCH"})
 _FAMILY_RE = re.compile(r"^xgbtpu_[a-z0-9_]+$")
 _KNOB_RE = re.compile(r"XGBTPU_[A-Z0-9_]+")
+#: the event-name grammar: dotted lowercase (``gang.fence``) — the
+#: forcing function toward namespaced names, same as the metric grammar
+_EVENT_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+#: exit-code constant naming convention (``FENCE_RC``)
+_RC_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*_RC$")
+#: where the one true exit-code registry lives (XGT016)
+_RC_REGISTRY_SUFFIX = "reliability/rc.py"
+#: POSIX-generic exit codes every CLI uses freely: success, generic
+#: failure, usage error — below the registered-protocol range
+_GENERIC_RCS = frozenset({0, 1, 2})
 _METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram",
                            "LabeledCounter", "LabeledGauge",
                            "counter", "gauge", "histogram"})
@@ -122,6 +154,17 @@ class Facts:
         self.lock_edges: List[Tuple[str, str, str, int]] = []
         # (file, call 'urlopen'|'HTTPConnection'|..., line, has_timeout)
         self.http_calls: List[Tuple[str, str, int, bool]] = []
+        # (file, NAME_RC, value, line) from the registry file itself
+        self.rc_defs: List[Tuple[str, str, int, int]] = []
+        # (file, NAME_RC, value, line) defined OUTSIDE the registry
+        self.rc_assigns: List[Tuple[str, str, int, int]] = []
+        # (file, 'exit'|'_exit', literal value, line)
+        self.exit_calls: List[Tuple[str, str, int, int]] = []
+        # (file, compared-name, literal value, line): returncode-ish
+        # names compared against bare int literals
+        self.rc_compares: List[Tuple[str, str, int, int]] = []
+        # (file, event name, line): trace.event()/_event()/emit() sites
+        self.events: List[Tuple[str, str, int]] = []
         # file -> every string constant in it (param-consumption check)
         self.str_consts: Dict[str, Set[str]] = {}
         # file -> Suppressions (inline disables apply to contract
@@ -279,6 +322,7 @@ def collect_file(ctx: FileContext, facts: Facts) -> None:
             seen_clients.add(key)
             facts.clients.append((ctx.path, method, path, line))
 
+    _collect_rc_defs(ctx, facts)
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Constant) and isinstance(node.value, str):
             consts.add(node.value)
@@ -289,12 +333,16 @@ def collect_file(ctx: FileContext, facts: Facts) -> None:
             _collect_param_table(ctx, node, facts)
         if isinstance(node, ast.Subscript):
             _collect_env_subscript(ctx, node, res, facts)
+        if isinstance(node, ast.Compare):
+            _collect_rc_compare(ctx, node, facts)
         if not isinstance(node, ast.Call):
             continue
         _collect_metric_ctor(ctx, node, res, facts)
         _collect_env_call(ctx, node, res, facts)
         _collect_client_call(node, add_client)
         _collect_http_timeout(ctx, node, facts)
+        _collect_exit_call(ctx, node, facts)
+        _collect_event(ctx, node, res, facts)
 
 
 def _collect_routes(ctx: FileContext, cls: ast.ClassDef,
@@ -467,6 +515,91 @@ def _collect_client_call(node: ast.Call, add_client) -> None:
             return
 
 
+# --------------------------------------------------- XGT016/XGT017 facts
+def _collect_rc_defs(ctx: FileContext, facts: Facts) -> None:
+    """Module-level ``NAME_RC = <int>`` assignments: registry entries
+    when the file IS ``reliability/rc.py``, out-of-registry definitions
+    (an XGT016 finding) anywhere else."""
+    is_registry = ctx.path.replace("\\", "/").endswith(_RC_REGISTRY_SUFFIX)
+    for node in ctx.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if not _RC_NAME_RE.match(name):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+                and not isinstance(node.value.value, bool)):
+            continue
+        dest = facts.rc_defs if is_registry else facts.rc_assigns
+        dest.append((ctx.path, name, node.value.value, node.lineno))
+
+
+def _collect_exit_call(ctx: FileContext, node: ast.Call,
+                       facts: Facts) -> None:
+    """``sys.exit`` / ``os._exit`` with a bare int literal."""
+    fname = terminal_name(node.func)
+    if fname not in ("exit", "_exit") or len(node.args) != 1:
+        return
+    arg = node.args[0]
+    if (isinstance(arg, ast.Constant) and isinstance(arg.value, int)
+            and not isinstance(arg.value, bool)):
+        facts.exit_calls.append((ctx.path, fname, arg.value, node.lineno))
+
+
+def _collect_rc_compare(ctx: FileContext, node: ast.Compare,
+                        facts: Facts) -> None:
+    """``p.returncode == 143``-style comparisons: a returncode-ish name
+    (contains ``rc`` or ``returncode``) against a bare int literal.
+    The checker only flags literals matching a REGISTERED code —
+    ``rc == 0`` and arbitrary small ints stay out of scope."""
+    if not all(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+        return
+    operands = [node.left] + list(node.comparators)
+    for a, b in zip(operands, operands[1:]):
+        for name_node, lit_node in ((a, b), (b, a)):
+            t = terminal_name(name_node)
+            if t is None or not ("rc" in t.lower()
+                                 or "returncode" in t.lower()):
+                continue
+            if (isinstance(lit_node, ast.Constant)
+                    and isinstance(lit_node.value, int)
+                    and not isinstance(lit_node.value, bool)):
+                facts.rc_compares.append(
+                    (ctx.path, t, lit_node.value, node.lineno))
+
+
+def _collect_event(ctx: FileContext, node: ast.Call,
+                   res: _FileResolver, facts: Facts) -> None:
+    """Event-name emission sites: ``trace.event(name, ...)`` and the
+    trainers' ``self._event(name, ...)`` wrappers (resolved through
+    the constant resolver), plus literal ``{"kind": "event"}`` dicts
+    handed straight to ``events.emit`` — the profiler's span-record
+    emits carry ``"kind": "span"`` and are excluded by that key."""
+    fname = terminal_name(node.func)
+    if fname in ("event", "_event") and node.args:
+        for name in (res.resolve(node.args[0]) or ()):
+            if _EVENT_RE.match(name):
+                facts.events.append((ctx.path, name, node.lineno))
+        return
+    if fname != "emit" or not node.args:
+        return
+    d = node.args[0]
+    if not isinstance(d, ast.Dict):
+        return
+    fields: Dict[str, ast.AST] = {}
+    for k, v in zip(d.keys, d.values):
+        ks = const_str(k) if k is not None else None
+        if ks:
+            fields[ks] = v
+    if "kind" in fields and const_str(fields["kind"]) == "event":
+        name = (const_str(fields["name"])
+                if "name" in fields else None)
+        if name and _EVENT_RE.match(name):
+            facts.events.append((ctx.path, name, node.lineno))
+
+
 #: outbound-HTTP constructors that take a ``timeout`` (XGT012).
 #: ``urlopen`` hangs forever without one; the two connection classes
 #: default to the GLOBAL socket timeout, which is None in practice.
@@ -507,12 +640,9 @@ def _doc_metric_table(text: str) -> Dict[str, Tuple[Optional[str], int]]:
     return out
 
 
-def _expand_doc_token(tok: str) -> List[Tuple[str, Optional[str]]]:
-    label = None
-    m = re.search(r"\{([a-z_]+)=\}$", tok)
-    if m:
-        label = m.group(1)
-        tok = tok[:m.start()]
+def _expand_braces(tok: str) -> List[str]:
+    """``a.{b,c}.d`` -> ``["a.b.d", "a.c.d"]`` (the doc tables' row
+    compression; shared by the metric and event inventories)."""
     names = [tok]
     while True:
         expanded: List[str] = []
@@ -528,8 +658,39 @@ def _expand_doc_token(tok: str) -> List[Tuple[str, Optional[str]]]:
                 expanded.append(n)
         names = expanded
         if not changed:
-            break
-    return [(n, label) for n in names if _FAMILY_RE.match(n)]
+            return names
+
+
+def _expand_doc_token(tok: str) -> List[Tuple[str, Optional[str]]]:
+    label = None
+    m = re.search(r"\{([a-z_]+)=\}$", tok)
+    if m:
+        label = m.group(1)
+        tok = tok[:m.start()]
+    return [(n, label) for n in _expand_braces(tok)
+            if _FAMILY_RE.match(n)]
+
+
+def _doc_event_table(text: str) -> Dict[str, int]:
+    """Parse OBSERVABILITY.md's EVENT inventory: backticked tokens in
+    the first cell of table rows under the "Event inventory" heading
+    (and only there — the span table also uses dotted names, so the
+    parse is heading-scoped).  ``{a,b}`` groups expand; tokens not
+    matching the event grammar are ignored."""
+    out: Dict[str, int] = {}
+    in_section = False
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if line.startswith("#"):
+            in_section = "event inventory" in line.lower()
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        first_cell = line.lstrip().lstrip("|").split("|", 1)[0]
+        for tok in re.findall(r"`([^`]+)`", first_cell):
+            for name in _expand_braces(tok.strip()):
+                if _EVENT_RE.match(name):
+                    out.setdefault(name, lineno)
+    return out
 
 
 def _doc_knobs(text: str) -> Dict[str, int]:
@@ -613,6 +774,10 @@ class ContractEngine:
             findings += self._check_locks(facts)
         if "XGT012" in self.codes:
             findings += self._check_timeouts(facts)
+        if "XGT016" in self.codes:
+            findings += self._check_exit_codes(facts)
+        if "XGT017" in self.codes:
+            findings += self._check_events(facts)
         findings += self._check_inventory_drift(facts)
         active: List[Finding] = []
         suppressed: List[Finding] = []
@@ -789,6 +954,88 @@ class ContractEngine:
                 "machinery exists to bound); pass timeout="))
         return out
 
+    # ------------------------------------------------------------ XGT016
+    def _check_exit_codes(self, facts: Facts) -> List[Finding]:
+        out: List[Finding] = []
+        registry: Dict[int, str] = {}
+        for file, name, value, line in sorted(
+                facts.rc_defs, key=lambda t: (t[0], t[3])):
+            if value in registry:
+                out.append(self._finding(
+                    "XGT016", file, line,
+                    f"exit code {value} registered twice "
+                    f"({registry[value]} and {name}) — the launcher "
+                    "dispatches recovery on the VALUE, two names for "
+                    "one code is a routing ambiguity"))
+            else:
+                registry[value] = name
+        for file, name, value, line in facts.rc_assigns:
+            hint = (f" (collides with registered {registry[value]})"
+                    if value in registry else "")
+            out.append(self._finding(
+                "XGT016", file, line,
+                f"exit-code constant {name} = {value} defined outside "
+                f"the registry{hint} — reliability/rc.py is the single "
+                "home; define it there and import it"))
+        for file, call, value, line in facts.exit_calls:
+            if value in registry:
+                out.append(self._finding(
+                    "XGT016", file, line,
+                    f"{call}({value}) spells registered exit code "
+                    f"{registry[value]} as a magic literal — import it "
+                    "from reliability.rc so the registry stays the "
+                    "single source of truth"))
+            elif value not in _GENERIC_RCS:
+                out.append(self._finding(
+                    "XGT016", file, line,
+                    f"{call}({value}): unregistered protocol exit code "
+                    "— register a *_RC constant in reliability/rc.py "
+                    "(0/1/2 are POSIX-generic and exempt); the "
+                    "launcher cannot dispatch recovery on a code it "
+                    "has no name for"))
+        for file, name, value, line in facts.rc_compares:
+            if value in registry:
+                out.append(self._finding(
+                    "XGT016", file, line,
+                    f"comparison of {name} against magic literal "
+                    f"{value} — that is registered exit code "
+                    f"{registry[value]}; compare against the constant "
+                    "so a registry renumber cannot desynchronize "
+                    "dispatch"))
+        return out
+
+    # ------------------------------------------------------------ XGT017
+    def _check_events(self, facts: Facts) -> List[Finding]:
+        out: List[Finding] = []
+        if not facts.events:
+            return out
+        doc_text, doc_path = self._doc(OBSERVABILITY_DOC)
+        if doc_text is None:
+            return out
+        documented = _doc_event_table(doc_text)
+        emitted: Dict[str, Tuple[str, int]] = {}
+        for file, name, line in sorted(
+                facts.events, key=lambda t: (t[0], t[2])):
+            emitted.setdefault(name, (file, line))
+        for name, (file, line) in sorted(emitted.items()):
+            if name not in documented:
+                out.append(self._finding(
+                    "XGT017", file, line,
+                    f"event {name!r} is emitted here but missing from "
+                    f"{OBSERVABILITY_DOC}'s event inventory table — "
+                    "add a row (full dotted name in backticks); "
+                    "obs_report and the chaos selftests grep event "
+                    "names, an undocumented one is invisible tooling "
+                    "surface"))
+        for name, lineno in sorted(documented.items()):
+            if name not in emitted:
+                out.append(self._finding(
+                    "XGT017", doc_path, lineno,
+                    f"{OBSERVABILITY_DOC} documents event {name!r}, "
+                    "which nothing emits — stale row or renamed "
+                    "event"))
+        return out
+
     # -------------------------------------------------------- inventory
     def inventory(self) -> dict:
         """The committed-contract view of the extracted facts: stable,
@@ -817,8 +1064,14 @@ class ContractEngine:
         # proof the tree has no timeout-less client)
         http_clients = sorted({(self._rel(f), call, has_t)
                                for f, call, _, has_t in facts.http_calls})
+        # XGT016/XGT017 inventories: the registered exit-code protocol
+        # (name -> value, sorted by value — recovery dispatch order)
+        # and every emitted obs event name
+        exit_codes = dict(sorted(
+            {name: value for _, name, value, _ in facts.rc_defs}.items(),
+            key=lambda kv: kv[1]))
         return {
-            "version": 1,
+            "version": 2,
             "http_routes": [
                 {"file": f, "handler": cls, "method": m, "path": p}
                 for f, cls, m, p in routes],
@@ -831,6 +1084,8 @@ class ContractEngine:
             "http_clients": [
                 {"file": f, "call": c, "timeout": t}
                 for f, c, t in http_clients],
+            "exit_codes": exit_codes,
+            "events": sorted({n for _, n, _ in facts.events}),
         }
 
     def contracts_path(self) -> str:
@@ -861,7 +1116,9 @@ class ContractEngine:
                      "env_knobs": "XGT010",
                      "cli_params": "XGT010",
                      "lock_edges": "XGT011",
-                     "http_clients": "XGT012"}
+                     "http_clients": "XGT012",
+                     "exit_codes": "XGT016",
+                     "events": "XGT017"}
 
     def _check_inventory_drift(self, facts: Facts) -> List[Finding]:
         """The committed ANALYSIS_CONTRACTS.json must match what the

@@ -12,8 +12,14 @@ PR and that no generic tool checks:
   XGT006  wall-clock ``time.time()`` used to measure durations
   XGT007  collectives under rank-dependent control flow
 
-The cross-file contract rules XGT008-XGT011 (HTTP route/client parity,
-metric-family drift, knob drift, static lock-order graph) live in
+The v3 dataflow-aware rules XGT013 (use-after-donate), XGT014 (impure
+traced scope) and XGT015 (collective axis discipline) live in
+:mod:`xgboost_tpu.analysis.dataflow` — they need a def-use view of a
+whole function, not one node — and are registered in ``_ALL_RULES``
+here so the CLI treats them like any per-file rule.
+
+The cross-file contract rules XGT008-XGT012, XGT016 (exit-code
+registry) and XGT017 (obs event-name drift) live in
 :mod:`xgboost_tpu.analysis.contracts` — they need whole-repo facts, not
 one file's AST.
 
@@ -591,9 +597,17 @@ class CollectiveUnderRankBranch(Rule):
                     break
 
 
+# the v3 dataflow-aware rules live in their own module (they share the
+# def-use/traced-scope layer); imported here, at the bottom, so the
+# registry stays the single source of truth without an import cycle
+from xgboost_tpu.analysis.dataflow import (CollectiveAxisDiscipline,  # noqa: E402
+                                           ImpureTracedScope,
+                                           UseAfterDonate)
+
 _ALL_RULES = (RecompileHazards, HostSyncInHotLoop, NonAtomicPersistence,
               SwallowedException, LockDiscipline, WallClockDuration,
-              CollectiveUnderRankBranch)
+              CollectiveUnderRankBranch, UseAfterDonate,
+              ImpureTracedScope, CollectiveAxisDiscipline)
 
 
 def all_rules() -> List[Rule]:

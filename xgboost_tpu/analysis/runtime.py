@@ -18,8 +18,15 @@ cause, in real executions under pytest:
   graph sees every LEXICAL order, not just the ones a test executed;
   tests/test_analysis_contracts.py cross-checks that runtime
   observations are a subset of that graph.
+- :class:`DonationGuard` is the runtime twin of the static XGT013
+  use-after-donate rule: it wraps a ``donate_argnums`` jitted callable
+  and, after each call, DELETES the device buffers the caller handed
+  over at donated positions — which is exactly what donation does on
+  TPU but what CPU silently skips (JAX warns and copies).  A caller
+  that touches a donated buffer post-call then raises loudly under
+  test on any backend, instead of reading garbage only on device.
 
-Both record violations instead of raising at the fault site, so a
+All record violations instead of raising at the fault site, so a
 stress test collects everything and fails once with the full report
 (``checker.assert_clean()``).
 """
@@ -261,4 +268,107 @@ class LockRaceChecker:
             report = "\n".join(v.render() for v in self.violations)
             raise AssertionError(
                 f"LockRaceChecker: {len(self.violations)} violation(s)\n"
+                + report)
+
+
+# ---------------------------------------------------------------- donation
+class DonationGuard:
+    """Runtime use-after-donate detector (dynamic twin of XGT013).
+
+    ``donate_argnums`` donation is a no-op on CPU — JAX warns once and
+    copies — so the whole tier-1 suite can pass while every donated
+    dispatch reads freed memory on TPU.  This guard makes CPU behave
+    like the device: :meth:`wrap` returns a shim that, after each call
+    completes, ``delete()``-s every jax-array leaf the caller passed at
+    a donated position.  From then on any caller-side touch of that
+    buffer raises JAX's own "Array has been deleted" — the runtime
+    observation of exactly the reads XGT013 flags statically.
+
+    Two hazards are RECORDED rather than raised, so a multi-dispatch
+    test collects everything and fails once via :meth:`assert_clean`:
+
+    - ``donated-reuse``: an argument arriving at a donated position is
+      already deleted — the caller re-passed a donated buffer instead
+      of rebinding the carry (the loop form of use-after-donate);
+    - ``non-donatable``: a donated position held a non-empty value
+      with no deletable device array in it (donation silently
+      pointless — e.g. a Python scalar burned into the trace).  An
+      EMPTY pytree at a donated position is vacuously fine — gbtree
+      donates ``tuple(eval_margins)`` unconditionally, and training
+      without evals passes ``()`` there.
+
+    Usage (the integration test drives the REAL fused dispatch)::
+
+        guard = DonationGuard(donate_argnums=(1, 11))
+        monkeypatch.setattr(gbtree, "_scan_rounds_donated",
+                            guard.wrap(gbtree._scan_rounds_donated))
+        ... run update_many with XGBTPU_FUSED_DONATE=1 ...
+        assert guard.calls > 0
+        guard.assert_clean()
+    """
+
+    def __init__(self, donate_argnums: Sequence[int]):
+        self.donate_argnums = tuple(donate_argnums)
+        self.calls = 0
+        self.violations: List[Violation] = []
+
+    def _record(self, kind: str, detail: str) -> None:
+        stack = "".join(traceback.format_stack(limit=8)[:-2])
+        self.violations.append(Violation(
+            kind=kind, detail=detail,
+            thread=threading.current_thread().name, stack=stack))
+
+    @staticmethod
+    def _array_leaves(value):
+        import jax
+        return [leaf for leaf in jax.tree_util.tree_leaves(value)
+                if isinstance(leaf, jax.Array)]
+
+    def wrap(self, fn):
+        """``fn`` with device-faithful donation semantics appended."""
+        import functools
+
+        import jax
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            donated = []
+            for i in self.donate_argnums:
+                if i >= len(args):
+                    continue
+                leaves = self._array_leaves(args[i])
+                if not leaves:
+                    if jax.tree_util.tree_leaves(args[i]):
+                        self._record(
+                            "non-donatable",
+                            f"donated position {i} of {fn.__name__} "
+                            "holds no device array — donation is "
+                            "silently a no-op there")
+                    continue
+                for leaf in leaves:
+                    if leaf.is_deleted():
+                        self._record(
+                            "donated-reuse",
+                            f"argument at donated position {i} of "
+                            f"{fn.__name__} was ALREADY donated by an "
+                            "earlier call — rebind the carry instead "
+                            "of re-passing the dead buffer")
+                    else:
+                        donated.append(leaf)
+            out = fn(*args, **kwargs)
+            # the computation must have consumed its inputs before the
+            # host frees them out from under an async dispatch
+            jax.block_until_ready(out)
+            for leaf in donated:
+                leaf.delete()
+            self.calls += 1
+            return out
+
+        return wrapper
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            report = "\n".join(v.render() for v in self.violations)
+            raise AssertionError(
+                f"DonationGuard: {len(self.violations)} violation(s)\n"
                 + report)

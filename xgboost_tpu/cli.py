@@ -314,9 +314,11 @@ class BoostLearnTask:
                 raise
             except BaseException:
                 import traceback
+
+                from xgboost_tpu.reliability.rc import WORKER_CRASH_RC
                 traceback.print_exc()
                 sys.stderr.flush()
-                os._exit(41)
+                os._exit(WORKER_CRASH_RC)
         return self._dispatch_marked()
 
     def _dispatch_marked(self) -> int:

@@ -38,6 +38,8 @@ import urllib.error
 import urllib.request
 from typing import Callable, Dict, List, Optional
 
+from xgboost_tpu.reliability.rc import REPLICA_KILL_RC
+
 # breaker states (per replica, managed by Membership under its lock)
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
@@ -741,7 +743,7 @@ class LeaseClient:
         # device budget advertisement: () -> {"budget_bytes":..,
         # "used_bytes":..} — the placer bin-packs against this
         self.device_fn = device_fn or (lambda: None)
-        self.on_kill = on_kill or (lambda: os._exit(43))
+        self.on_kill = on_kill or (lambda: os._exit(REPLICA_KILL_RC))
         self.lease_sec = 10.0
         self.registered = False
         self.heartbeats_sent = 0

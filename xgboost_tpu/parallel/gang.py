@@ -48,10 +48,12 @@ PARTITION_SEC_ENV = "XGBTPU_GANG_PARTITION_SEC"
 #: host_loss fault no longer fires (the lost host is not scheduled)
 DEGRADED_ENV = "XGBTPU_GANG_DEGRADED"
 
-#: worker exit code for a self-fence (coordinator unreachable too long)
-FENCE_RC = 143
-#: worker exit code for a simulated permanent host death
-HOST_LOSS_RC = 144
+#: worker exit codes (registry: reliability/rc.py, lint rule XGT016):
+#: FENCE_RC for a self-fence (coordinator unreachable too long),
+#: HOST_LOSS_RC for a simulated permanent host death; re-exported here
+#: for the launcher and tests, which read them off this module
+from xgboost_tpu.reliability.rc import (FENCE_RC,  # noqa: F401
+                                        HOST_LOSS_RC)
 
 #: beacon file the launcher touches every poll tick
 BEACON_NAME = "coord"

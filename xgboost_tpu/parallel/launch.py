@@ -51,13 +51,14 @@ NWORKER_ENV = "XGBTPU_NUM_WORKER"
 RANK_ENV = "XGBTPU_WORKER_ID"
 TRIAL_ENV = "XGBTPU_NUM_TRIAL"
 
-#: exit code launch_local returns for an unrecovered stall (no
-#: keepalive / restart budget exhausted) — worker rcs are small
-STALL_RC = 142
-#: exit code for a coordinator superseded by a standby takeover: it
-#: must stop supervising (the new holder owns the workers) and report
-#: neither success nor worker failure
-COORD_FENCED_RC = 145
+#: exit codes (registry: reliability/rc.py, lint rule XGT016) —
+#: STALL_RC for an unrecovered stall (no keepalive / restart budget
+#: exhausted), COORD_FENCED_RC for a coordinator superseded by a
+#: standby takeover (it must stop supervising and report neither
+#: success nor worker failure); re-exported here for callers that
+#: import them from the launcher
+from xgboost_tpu.reliability.rc import (COORD_FENCED_RC,  # noqa: F401
+                                        STALL_RC)
 #: grow-back signal file in the gang dir: a replacement worker (or the
 #: operator) touches it to ask a DEGRADED gang to re-expand to full
 #: size at the next segment boundary (= checkpoint resume point)
