@@ -32,9 +32,9 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from xgboost_tpu.config import (CATALOG_PARAMS, FLEET_PARAMS,
-                                PIPELINE_PARAMS, PLACER_PARAMS,
-                                SERVE_PARAMS, STREAM_PARAMS,
-                                parse_config_file)
+                                LANE_PARAMS, PIPELINE_PARAMS,
+                                PLACER_PARAMS, SERVE_PARAMS,
+                                STREAM_PARAMS, parse_config_file)
 
 # process start, for recovery-cost accounting.  perf_counter, not
 # wall-clock: these readings are only ever subtracted (XGT006)
@@ -63,6 +63,13 @@ Tasks (task=...):
           candidate against the incumbent on a holdout, and atomically
           publish to the path the serving tier polls — directly or
           through the fleet canary lane (pipeline_router_url=)
+  lanes   gang-batched multi-tenant continuous training
+          (xgboost_tpu.pipeline.lanes, PIPELINE.md "Gang-batched
+          lanes"): one pipeline per lanes= tenant, same-shape lanes
+          vmap-stacked into ONE device dispatch per round segment
+          (XGBTPU_LANE_STACK=0 for the independent host-loop
+          baseline); per-lane gate/publish knobs ride the pipeline_*
+          table
   placer  autonomous catalog placement (xgboost_tpu.placer, SERVING.md
           "Autonomous placement"): watch the router's per-tenant load,
           bin-pack placer_catalog models onto in-rotation replicas
@@ -88,6 +95,9 @@ task=stream parameters (streaming drift-aware continuous learning):
 
 catalog parameters (multi-tenant serving, task=serve + task=fleet_router):
 {catalog_params}
+
+task=lanes parameters (gang-batched multi-tenant training):
+{lane_params}
 
 task=placer parameters (autonomous placement + elastic fleet):
 {placer_params}
@@ -139,6 +149,7 @@ class BoostLearnTask:
                                for k, (v, _) in CATALOG_PARAMS.items()}
         self.placer_params = {k: v
                               for k, (v, _) in PLACER_PARAMS.items()}
+        self.lane_params = {k: v for k, (v, _) in LANE_PARAMS.items()}
 
     # ------------------------------------------------------------- params
     _OWN = {
@@ -221,6 +232,8 @@ class BoostLearnTask:
             self.catalog_params[name] = type(CATALOG_PARAMS[name][0])(val)
         elif name in self.placer_params:
             self.placer_params[name] = type(PLACER_PARAMS[name][0])(val)
+        elif name in self.lane_params:
+            self.lane_params[name] = type(LANE_PARAMS[name][0])(val)
         else:
             m = re.match(r"eval\[([^\]]+)\]", name)
             if m:
@@ -236,6 +249,7 @@ class BoostLearnTask:
         if not argv:
             from xgboost_tpu.config import (catalog_params_help,
                                             fleet_params_help,
+                                            lane_params_help,
                                             pipeline_params_help,
                                             placer_params_help,
                                             serve_params_help,
@@ -245,6 +259,7 @@ class BoostLearnTask:
                                 pipeline_params=pipeline_params_help(),
                                 stream_params=stream_params_help(),
                                 catalog_params=catalog_params_help(),
+                                lane_params=lane_params_help(),
                                 placer_params=placer_params_help()))
             return 0
         if os.path.exists(argv[0]) or "=" not in argv[0]:
@@ -402,6 +417,8 @@ class BoostLearnTask:
             return self.task_pipeline()
         if self.task == "stream":
             return self.task_stream()
+        if self.task == "lanes":
+            return self.task_lanes()
         if self.task == "placer":
             return self.task_placer()
         raise ValueError(f"unknown task {self.task!r}")
@@ -753,6 +770,58 @@ class BoostLearnTask:
         if self.silent < 2:
             print(f"[pipeline] done: {summary}", file=sys.stderr)
         return 0 if summary.get("errors", 0) == 0 else 1
+
+    # -------------------------------------------------------------- lanes
+    def task_lanes(self) -> int:
+        """Gang-batched multi-tenant continuous training
+        (xgboost_tpu.pipeline.lanes, PIPELINE.md "Gang-batched lanes"):
+        one train -> gate -> publish pipeline per ``lanes=`` tenant,
+        with same-shape lanes vmap-stacked into one device dispatch per
+        round segment.  Per-lane gate/publish knobs (metric, deltas,
+        router, sleep) come from the pipeline_* table; learner
+        hyperparameters pass through like ``task=train``."""
+        from xgboost_tpu.catalog import parse_manifest
+        from xgboost_tpu.pipeline import run_tenant_lanes
+        lp = self.lane_params
+        pp = self.pipeline_params
+        if not lp["lanes"]:
+            raise ValueError("task=lanes requires lanes= "
+                             "(name=publish_path,... or a manifest "
+                             "file)")
+        manifest = parse_manifest(lp["lanes"])
+        data = lp["lane_data"] or self.train_path
+        holdout = lp["lane_holdout"]
+        lanes = {}
+        for name, publish_path in manifest.items():
+            lanes[name] = dict(
+                publish_path=publish_path,
+                workdir=os.path.join(lp["lanes_dir"], name),
+                data=data.replace("{lane}", name),
+                holdout=holdout.replace("{lane}", name),
+                rounds_per_cycle=lp["lane_rounds_per_cycle"],
+                cycles=lp["lane_cycles"],
+                metric=pp["pipeline_metric"],
+                min_delta=pp["pipeline_min_delta"],
+                max_regression=pp["pipeline_max_regression"],
+                router_url=pp["pipeline_router_url"],
+                publish_timeout_sec=pp["pipeline_publish_timeout_sec"],
+                sleep_sec=pp["pipeline_sleep_sec"],
+                params=self._params_dict())
+        stacked = (None if lp["lane_stack"] < 0
+                   else bool(lp["lane_stack"]))
+        if self.silent < 2:
+            print(f"[lanes] training {len(lanes)} tenant lane(s) "
+                  f"(stacked={'auto' if stacked is None else stacked})",
+                  file=sys.stderr)
+        out = run_tenant_lanes(
+            lanes, quiet=self.silent != 0, stacked=stacked,
+            max_workers=lp["lane_max_workers"] or None,
+            window_sec=lp["lane_window_ms"] / 1000.0)
+        errors = sum(1 for v in out.values() if v.get("status") != "ok")
+        if self.silent < 2:
+            for name in sorted(out):
+                print(f"[lanes] {name}: {out[name]}", file=sys.stderr)
+        return 0 if errors == 0 else 1
 
     # ------------------------------------------------------------- stream
     def task_stream(self) -> int:

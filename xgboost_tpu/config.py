@@ -445,6 +445,59 @@ def pipeline_params_help() -> str:
                      for name, (default, help_) in PIPELINE_PARAMS.items())
 
 
+# ---------------------------------------------------------------- lanes
+# task=lanes parameters (xgboost_tpu.pipeline.lanes, PIPELINE.md
+# "Gang-batched lanes") — gang-batched multi-tenant continuous
+# training: one pipeline per catalog tenant, same-shape lanes
+# vmap-stacked into ONE device dispatch per round segment.  Per-lane
+# gate knobs reuse the pipeline_* table (metric, min_delta,
+# max_regression, router_url, publish_timeout_sec, sleep_sec apply to
+# every lane).  Same single-table discipline as PIPELINE_PARAMS
+# (XGT010 + contracts inventory).
+LANE_PARAMS: Dict[str, Tuple[Any, str]] = {
+    "lanes": ("", "tenant lane manifest: inline 'name=publish_path' "
+                  "pairs (comma-separated) or a 'name = publish_path' "
+                  "config file — one continuous-training pipeline per "
+                  "tenant (REQUIRED for task=lanes)"),
+    "lanes_dir": ("./lanes", "root working directory; each lane keeps "
+                             "its own cycle state, checkpoint ring, "
+                             "quarantine and gated-hash ledger under "
+                             "<lanes_dir>/<name>"),
+    "lane_stack": (-1, "gang-batched execution: 1 = vmap-stack "
+                       "same-shape lanes into one device dispatch per "
+                       "round segment, 0 = independent host-loop "
+                       "pipelines (the A/B baseline), -1 = auto "
+                       "(XGBTPU_LANE_STACK env, default stacked)"),
+    "lane_window_ms": (200.0, "rendezvous window: a cycle's boosting "
+                              "dispatches when every active lane has "
+                              "arrived or this many ms passed since "
+                              "the first arrival; late lanes join the "
+                              "next batch (model bytes never depend "
+                              "on batch composition — only dispatch "
+                              "sharing does)"),
+    "lane_max_workers": (0, "concurrent lane threads (0 = auto: all "
+                            "lanes when stacked — threads idle at the "
+                            "rendezvous while the device works — else "
+                            "min(lanes, 8) for the host loop)"),
+    "lane_data": ("", "per-lane training data: {lane} and {cycle} "
+                      "placeholders substitute the lane name and "
+                      "cycle index (falls back to data=)"),
+    "lane_holdout": ("", "per-lane gate holdout; a {lane} placeholder "
+                         "substitutes the lane name"),
+    "lane_rounds_per_cycle": (5, "boosting rounds appended per cycle "
+                                 "in every lane (equal-shape lanes "
+                                 "share one compiled stacked scan)"),
+    "lane_cycles": (1, "cycles each lane runs before exiting (0 = run "
+                       "forever)"),
+}
+
+
+def lane_params_help() -> str:
+    """One line per task=lanes parameter, for CLI usage text."""
+    return "\n".join(f"  {name:<26} {help_} (default {default!r})"
+                     for name, (default, help_) in LANE_PARAMS.items())
+
+
 # --------------------------------------------------------------- stream
 # task=stream parameters (xgboost_tpu.stream, PIPELINE.md streaming
 # section) — same single-table discipline as PIPELINE_PARAMS: the

@@ -17,7 +17,7 @@ from __future__ import annotations
 import json
 import os
 import sys
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +79,40 @@ class _CacheEntry:
         return x[:self.n_real]
 
 
+class LaneSpec(NamedTuple):
+    """One tenant's gang-batching contract (:meth:`Booster.fused_lane_spec`):
+    everything the lane-stacking driver (``pipeline/lanes.py``) needs to
+    vmap this booster's next fused rounds alongside its shape-bucket
+    peers in ONE device dispatch.  The static fields (cfg, finder and
+    gradient identities, K/npar, pred_chunk, shapes) form the bucket
+    key — lanes stack only when every static matches, so the stacked
+    scan's compiled program is exactly the solo scan's under ``vmap``.
+    The device fields are the solo scan's own operands; margins are
+    already synced (``_sync_margin``) when the spec is handed out."""
+    booster: "Booster"
+    entry: _CacheEntry
+    n_rows: int              # device row count (N_pad of the entry)
+    n_features: int
+    n_rounds: int
+    first_iteration: int
+    seg_k: int               # resolved rounds-per-dispatch segment size
+    K: int                   # num_output_group
+    npar: int                # num_parallel_tree
+    cfg: object              # GrowConfig (hashable; static scan arg)
+    split_finder: object     # stable identity or None
+    grad_fn: object          # Objective.fused_grad (stable identity)
+    pred_chunk: int
+    subsample: float         # < 1.0 forbids row padding (N-shaped draws)
+    binned: jax.Array        # (N, F) device bins
+    margin: jax.Array        # (N, K) synced margins
+    label: jax.Array
+    weight: jax.Array
+    base_key: jax.Array      # PRNGKey(seed) — the solo scan's own key
+    cut_values: jax.Array    # (F, W) f32
+    n_cuts: jax.Array        # (F,) int32
+    row_valid: Optional[jax.Array]   # (N,) bool or None (= all real)
+
+
 class Booster:
     """Learner handle (reference wrapper/xgboost.py Booster + BoostLearner)."""
 
@@ -137,6 +171,7 @@ class Booster:
                                                self.gbtree.cuts.max_bin)
             # updater / sketch params may have changed the split finder
             self.gbtree._split_finder_cache = None
+            self.gbtree._base_key_cache = None  # seed may have changed
 
     def set_feature_screen(self, kept=None) -> None:
         """Restrict FUSED training's histogram working set to ``kept``
@@ -1154,6 +1189,89 @@ class Booster:
             done += seg
             if segment_callback is not None:
                 segment_callback(first + seg - 1)
+
+    def fused_lane_spec(self, dtrain: DMatrix, first_iteration: int,
+                        n_rounds: int, rounds_per_dispatch=None):
+        """Gang-batching eligibility + operand bundle for this booster's
+        next ``n_rounds`` fused rounds (PIPELINE.md "Gang-batched
+        lanes").  Returns ``(LaneSpec, None)`` when the lane-stacking
+        driver may vmap this booster with same-bucket peers, else
+        ``(None, reason)`` — the reasons mirror :meth:`update_many`'s
+        fused checks plus the stacking-only restrictions (any mesh,
+        rank relayouts, an active feature screen): a declined lane runs
+        solo through the normal :meth:`update_many` path, which decides
+        its own fused-vs-per-round route.
+
+        Side effects on eligibility match the fused path exactly:
+        labels are host-validated once and the entry margin is synced,
+        so the returned ``margin``/``binned`` are the solo scan's own
+        operands and a stacked dispatch is bit-identical per lane.
+        """
+        from xgboost_tpu.models.updaters import parse_updaters
+        if self.param.booster != "gbtree":
+            return None, "booster"
+        self._lazy_init(dtrain)
+        entry = self._entry(dtrain)
+        ups = parse_updaters(self.param.updater)
+        grad_fn = (None if entry.rank_pad_prep is not None
+                   else self.obj.fused_grad(entry.info))
+        checks = (
+            ("no_rounds", n_rounds < 1),
+            ("external_train", bool(entry.external)),
+            ("mesh", self._mesh is not None),
+            ("col_split", self._col_mesh is not None),
+            ("seq_boost_env", bool(os.environ.get("XGBTPU_SEQ_BOOST"))),
+            ("profiler", self.profiler is not None),
+            ("prune", self.param.gamma > 0.0 and "prune" in ups),
+            ("multi_root", max(1, self.param.num_roots) != 1),
+            ("exact", bool(getattr(self.gbtree, "exact_raw", False))),
+            ("refresh", "refresh" in ups),
+            ("no_grow_updater",
+             not any(u.startswith("grow") for u in ups)),
+            ("rank_layout", entry.rank_pad_prep is not None),
+            ("no_fused_grad", grad_fn is None),
+            ("feature_screen", self.param.ema_fs > 0
+             and self._feature_screen is not None),
+        )
+        blockers = [name for name, blocked in checks if blocked]
+        if blockers:
+            return None, blockers[0]
+        k = self._resolve_rounds_per_dispatch(dtrain.num_row,
+                                              rounds_per_dispatch)
+        if k <= 0:
+            return None, "rounds_per_dispatch_0"
+        self.obj.validate_labels(entry.info)  # host check, once per info
+        self._sync_margin(entry)
+        N = int(entry.binned.shape[0])
+        return LaneSpec(
+            booster=self, entry=entry, n_rows=N,
+            n_features=int(entry.binned.shape[1]),
+            n_rounds=int(n_rounds),
+            first_iteration=int(first_iteration), seg_k=int(k),
+            K=self._K, npar=max(1, self.param.num_parallel_tree),
+            cfg=self.gbtree.cfg,
+            split_finder=self.gbtree._split_finder(),
+            grad_fn=grad_fn, pred_chunk=self.gbtree.pred_chunk,
+            subsample=float(self.param.subsample),
+            binned=entry.binned, margin=entry.margin,
+            label=entry.info.label_dev(),
+            weight=entry.info.weight_dev(N),
+            base_key=self.gbtree.base_key(),
+            cut_values=self.gbtree.cut_values_dev,
+            n_cuts=self.gbtree.n_cuts_dev,
+            row_valid=entry.row_valid), None
+
+    def absorb_lane_segment(self, spec: LaneSpec, stacks, margin,
+                            n_rounds: int) -> None:
+        """Install one gang segment's per-lane outputs back into this
+        booster: the lane's flattened ``(n_rounds*K*npar, ...)`` tree
+        stack joins the ensemble and the scanned margin replaces the
+        entry's cached one (sliced back to the entry's own row count by
+        the caller).  Mirrors what :meth:`update_many` does after
+        ``do_boost_fused``."""
+        self.gbtree.absorb_round_stacks(stacks, n_rounds)
+        spec.entry.margin = margin
+        spec.entry.applied = self.gbtree.num_trees
 
     def boost(self, dtrain: DMatrix, grad, hess):
         """Boost from user-supplied gradients (reference

@@ -81,6 +81,18 @@ def _unstack_trees(stacked, t: int):
     return tuple(jax.tree.map(lambda x: x[i], stacked) for i in range(t))
 
 
+@functools.partial(jax.jit, static_argnames=("t",))
+def _unstack_lane_flats(stacked, t: int):
+    """Slice the lane axis of a (L, n_rounds, K*npar, ...) gang-scan
+    tree output into per-lane FLAT (n_rounds*K*npar, ...) stacks, all
+    in ONE device launch.  The flatten rides inside the same program:
+    reshaping eagerly per lane costs a dispatch per lane per tree field
+    and dominated the stacked cycle (tools/bench_lanes.py)."""
+    flat = jax.tree.map(
+        lambda x: x.reshape((x.shape[0], -1) + x.shape[3:]), stacked)
+    return tuple(jax.tree.map(lambda x: x[i], flat) for i in range(t))
+
+
 def _scan_rounds_impl(binned, margin, label, weight, base_key,
                       first_iteration, cut_values, n_cuts, row_valid,
                       binned_t, eval_binned, eval_margins, *,
@@ -260,6 +272,52 @@ _scan_rounds_mesh_donated = functools.partial(
     donate_argnums=(1, 11))(_scan_rounds_mesh_impl)
 
 
+def _scan_rounds_lanes_impl(binned, margin, label, weight, base_key,
+                            first_iteration, cut_values, n_cuts,
+                            row_valid, *, n_rounds: int, K: int,
+                            npar: int, cfg: GrowConfig, split_finder,
+                            grad_fn, pred_chunk: int):
+    """Lane-stacked round scan: ``jax.vmap`` of :func:`_scan_rounds_impl`
+    over a leading LANE axis — L same-shape tenant boosters advance
+    ``n_rounds`` rounds in ONE device dispatch (PIPELINE.md
+    "Gang-batched lanes").  Every operand carries the lane axis:
+    (L, N, F) bins, (L, N, K) margins/labels, (L,) first iterations,
+    (L, 2) RNG keys, (L, F, W) cut values, (L, N) row-validity masks.
+    Inactive pad rows/lanes are all-False ``row_valid`` — grow_tree
+    zeroes their gradients and parks them at ``pos = -1`` (the
+    histogram's existing inactive-row convention), so a pad lane grows
+    degenerate zero trees the host discards and a padded row never
+    touches a real lane's sums.  Watchlist eval stays HOST-side
+    (per-tenant gating needs per-tenant metrics anyway), so the eval
+    carry is empty.  ``first_iteration`` is dynamic and per-lane:
+    tenants at different incumbent rounds share one compiled dispatch.
+
+    Returns ``(final margins (L, N, K),
+    stacked trees (L, n_rounds, K*npar, ...))``.
+    """
+    def one(binned, margin, label, weight, base_key, first_iteration,
+            cut_values, n_cuts, row_valid):
+        m, _, stacks, _ = _scan_rounds_impl(
+            binned, margin, label, weight, base_key, first_iteration,
+            cut_values, n_cuts, row_valid, None, (), (),
+            n_rounds=n_rounds, K=K, npar=npar, cfg=cfg,
+            split_finder=split_finder, grad_fn=grad_fn, mesh=None,
+            eval_is_train=(), etransform=None, pred_chunk=pred_chunk)
+        return m, stacks
+
+    return jax.vmap(one)(binned, margin, label, weight, base_key,
+                         first_iteration, cut_values, n_cuts, row_valid)
+
+
+_LANE_STATIC = ("n_rounds", "K", "npar", "cfg", "split_finder",
+                "grad_fn", "pred_chunk")
+_scan_rounds_lanes = functools.partial(
+    jax.jit, static_argnames=_LANE_STATIC)(_scan_rounds_lanes_impl)
+_scan_rounds_lanes_donated = functools.partial(
+    jax.jit, static_argnames=_LANE_STATIC,
+    donate_argnums=(1,))(_scan_rounds_lanes_impl)
+
+
 class GBTree:
     """Tree ensemble state + boosting step (reference IGradBooster: DoBoost /
     Predict / PredictLeaf / DumpModel, src/gbm/gbm.h:19-125)."""
@@ -281,12 +339,22 @@ class GBTree:
         self._trees_list: List[TreeArrays] = []  # materialized per-tree pytrees
         # stacked trees not yet sliced into _trees_list (fused rounds /
         # model load keep the ensemble stacked; slicing T trees eagerly
-        # costs a T-output jit per distinct T and duplicates the stack)
-        self._pending: Optional[Tuple[TreeArrays, int]] = None
+        # costs a T-output jit per distinct T and duplicates the stack).
+        # Held as a LIST of flat (t_i, ...) stacks so absorbing a scan
+        # segment is a pure host append — concatenation is deferred to
+        # the first _stack()/trees read (the gang-batched lane driver
+        # absorbs N tenants per dispatch; N*leaves tiny device concats
+        # per segment would swamp the stacked scan it just saved)
+        self._pending: Optional[Tuple[List[TreeArrays], int]] = None
         self.tree_group: List[int] = []
         self._stack_cache: Optional[Tuple[int, TreeArrays, jax.Array]] = None
         self.cut_values_dev = jnp.asarray(cuts.cut_values)
         self.n_cuts_dev = jnp.asarray(cuts.n_cuts)
+        # PRNGKey(seed), built once: a stable OBJECT, not just a stable
+        # value — the lane-stacking driver's steady-bucket carry keys on
+        # identity, and a per-cycle PRNGKey would be one device dispatch
+        # per lane per cycle for a constant
+        self._base_key_cache: Optional[jax.Array] = None
         self._col_pad_cache = None  # (n_shard, cut_values, n_cuts)
         # (kept_ids, cut_values, n_cuts, kept_dev) of the EMA-FS
         # feature screen (do_boost_fused feature_screen=); rebuilding
@@ -312,10 +380,18 @@ class GBTree:
         on first access (prediction/save after fused training go through
         the stack cache and never pay this)."""
         if self._pending is not None:
-            flat, t = self._pending
+            flats, t = self._pending
             self._pending = None
+            flat = flats[0] if len(flats) == 1 else jax.tree.map(
+                lambda *xs: jnp.concatenate(xs), *flats)
             self._trees_list.extend(_unstack_trees(flat, t))
         return self._trees_list
+
+    def base_key(self) -> jax.Array:
+        """The booster's root ``PRNGKey(seed)`` (cached; see __init__)."""
+        if self._base_key_cache is None:
+            self._base_key_cache = jax.random.PRNGKey(self.param.seed)
+        return self._base_key_cache
 
     def col_arrays(self, n_shard: int):
         """Cut arrays feature-padded to the column mesh (cached: padding
@@ -388,7 +464,7 @@ class GBTree:
                 cut_index=jnp.asarray(ci, jnp.int32))
             T = int(stack.feature.shape[0])
             self._trees_list = []
-            self._pending = (stack, T)
+            self._pending = ([stack], T)
             self._stack_cache = (T, stack, group)
         self.cuts = cuts
         self.cfg = make_grow_config(self.param, cuts.max_bin)
@@ -800,7 +876,7 @@ class GBTree:
             _t_launch = time.perf_counter()
             margin_f, emargins_f, stacks, eouts = scan(
                 binned, margin, label, weight,
-                jax.random.PRNGKey(self.param.seed),
+                self.base_key(),
                 jnp.int32(first_iteration), cut_vals,
                 cut_ns, row_valid, binned_t,
                 tuple(eval_binned), tuple(eval_margins),
@@ -832,32 +908,54 @@ class GBTree:
                 jnp.take(kept_dev,
                          jnp.clip(f, 0, kept_dev.shape[0] - 1)),
                 f))
+        self._append_flat_trees(flat, n_rounds)
+        return margin_f, emargins_f, eouts
+
+    def _append_flat_trees(self, flat, n_rounds: int) -> None:
+        """Append a flattened ``(n_rounds*K*npar, ...)`` tree stack grown
+        by a fused or lane-stacked scan: a pure host-side list append —
+        zero device dispatches.  Concatenation into the full-ensemble
+        stack is deferred to the next :meth:`_stack` read (one concat
+        per leaf, however many segments accumulated).  The gang-batched
+        lane driver absorbs N tenants per dispatch; eager per-lane
+        concat + cache rebuild here used to cost ~25 tiny device ops
+        per lane and swamped the stacked scan it had just saved
+        (tools/bench_lanes.py)."""
+        K = max(1, self.param.num_output_group)
+        npar = max(1, self.param.num_parallel_tree)
         group_new = [j // npar for _ in range(n_rounds)
                      for j in range(K * npar)]
-        if self.num_trees:
-            old_stack, old_group = self._stack(0)
-            full = jax.tree.map(lambda a, b: jnp.concatenate([a, b]),
-                                old_stack, flat)
-            full_group = jnp.concatenate(
-                [old_group, jnp.asarray(group_new, jnp.int32)])
-        else:
-            full = flat
-            full_group = jnp.asarray(group_new, jnp.int32)
         T_new = n_rounds * K * npar
         # keep the new trees STACKED (ADVICE r2: eager unstack compiles a
         # T-output program per distinct T and duplicates the cached
         # stack); the trees property slices lazily if anything needs
         # per-tree objects
         if self._pending is not None:
-            old_flat, old_t = self._pending
-            self._pending = (jax.tree.map(
-                lambda a, b: jnp.concatenate([a, b]), old_flat, flat),
-                old_t + T_new)
+            flats, old_t = self._pending
+            flats.append(flat)
+            self._pending = (flats, old_t + T_new)
+        elif self._trees_list:
+            # per-tree objects already materialized (paged/refresh
+            # paths): fold them back into the pending list so _stack()
+            # never re-slices
+            self._pending = ([jax.tree.map(lambda *xs: jnp.stack(xs),
+                                           *self._trees_list), flat],
+                             len(self._trees_list) + T_new)
+            self._trees_list = []
         else:
-            self._pending = (flat, T_new)
+            self._pending = ([flat], T_new)
         self.tree_group.extend(group_new)
-        self._stack_cache = (self.num_trees, full, full_group)
-        return margin_f, emargins_f, eouts
+        self._stack_cache = None
+
+    def absorb_round_stacks(self, flat, n_rounds: int) -> None:
+        """Install one lane's flattened ``(n_rounds*K*npar, ...)`` tree
+        stack as this booster's newest trees — the lane-stacked
+        driver's per-tenant unpack (pipeline/lanes.py): the gang
+        dispatch grew every lane's trees in one launch and
+        ``_unstack_lane_flats`` pre-flattened the round axis device-
+        side; each tenant absorbs its own slice exactly as
+        :meth:`do_boost_fused` would have (a pure host append)."""
+        self._append_flat_trees(flat, n_rounds)
 
     # ----------------------------------------------------------- paged boost
     def do_boost_paged(self, dmat, gh, key: jax.Array,
@@ -948,7 +1046,23 @@ class GBTree:
         if self._stack_cache is not None and self._stack_cache[0] == T:
             return self._stack_cache[1], self._stack_cache[2]
         assert T > 0, "model is empty"
-        stack = jax.tree.map(lambda *xs: jnp.stack(xs), *self.trees[:T])
+        if self._pending is not None and T == self.num_trees:
+            # full-ensemble read with pending flat segments: concat the
+            # segments directly (one op per leaf) instead of slicing T
+            # per-tree pytrees and re-stacking them.  Collapse the
+            # pending list so repeated appends stay O(segments-since-
+            # last-read), not O(all-segments-ever).
+            parts = ([jax.tree.map(lambda *xs: jnp.stack(xs),
+                                   *self._trees_list)]
+                     if self._trees_list else [])
+            parts.extend(self._pending[0])
+            stack = parts[0] if len(parts) == 1 else jax.tree.map(
+                lambda *xs: jnp.concatenate(xs), *parts)
+            if not self._trees_list:
+                self._pending = ([stack], T)
+        else:
+            stack = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                 *self.trees[:T])
         group = jnp.asarray(self.tree_group[:T], dtype=jnp.int32)
         self._stack_cache = (T, stack, group)
         return stack, group
@@ -1046,7 +1160,7 @@ class GBTree:
         T = stack.feature.shape[0]
         # stay stacked: prediction/save go through the stack cache; only
         # dump/refresh/prune-style per-tree access slices lazily
-        gbt._pending = (stack, T)
+        gbt._pending = ([stack], T)
         gbt.tree_group = [int(g) for g in state["tree_group_arr"]]
         gbt._stack_cache = (T, stack,
                             jnp.asarray(state["tree_group_arr"],

@@ -31,10 +31,12 @@ from xgboost_tpu.obs.events import (EventLog, configure_log,  # noqa: F401
                                     get_log)
 from xgboost_tpu.obs.metrics import (Counter, Gauge,  # noqa: F401
                                      Histogram, LabeledCounter,
-                                     LabeledGauge, MetricsRegistry,
+                                     LabeledGauge, LaneMetrics,
+                                     MetricsRegistry,
                                      PipelineMetrics, PredictMetrics,
                                      ReliabilityMetrics, ServingMetrics,
-                                     TrainingMetrics, pipeline_metrics,
+                                     TrainingMetrics, lane_metrics,
+                                     pipeline_metrics,
                                      predict_metrics, registry,
                                      reliability_metrics,
                                      training_metrics)
@@ -75,6 +77,7 @@ __all__ = [
     "ServingMetrics", "ReliabilityMetrics", "TrainingMetrics",
     "PredictMetrics", "predict_metrics",
     "PipelineMetrics", "pipeline_metrics",
+    "LaneMetrics", "lane_metrics",
     "reliability_metrics", "training_metrics",
     "RoundProfiler",
     "start_metrics_server", "get_metrics_server", "stop_metrics_server",

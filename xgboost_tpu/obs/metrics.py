@@ -749,6 +749,71 @@ def pipeline_metrics() -> PipelineMetrics:
     return _PIPELINE
 
 
+class LaneMetrics:
+    """Gang-batched tenant-lane accounting (``xgbtpu_lane_*``,
+    PIPELINE.md "Gang-batched lanes"): how many tenants each stacked
+    dispatch carried, how much of the stack was padding, how often a
+    lane fell back to its own solo dispatch stream and why, and the
+    shape-bucket population.  One instance per process
+    (:func:`lane_metrics`); rendered into every /metrics body via the
+    registry."""
+
+    def __init__(self, prefix: str = "xgbtpu_lane"):
+        p = prefix
+        self.dispatches = Counter(
+            f"{p}_dispatches_total",
+            "stacked multi-tenant segment dispatches (one device launch "
+            "each, regardless of how many lanes it carried)")
+        self.stacked = Counter(
+            f"{p}_stacked_total",
+            "real tenant lane-segments advanced by stacked dispatches")
+        self.padded = Counter(
+            f"{p}_padded_total",
+            "inactive pad lane-segments dispatched to round a bucket up "
+            "to its power-of-two stack width")
+        self.solo = LabeledCounter(
+            f"{p}_solo_total", "reason",
+            "lane cycles that ran the solo host-loop path instead of "
+            "stacking, by first blocking reason")
+        self.stack_width = Gauge(
+            f"{p}_stack_width",
+            "lane count (incl. padding) of the most recent stacked "
+            "dispatch")
+        self.buckets = Gauge(
+            f"{p}_buckets",
+            "distinct shape buckets in the most recent gang window")
+        self.dispatch_seconds = Histogram(
+            f"{p}_dispatch_seconds",
+            "wall time per stacked segment dispatch (all lanes in the "
+            "bucket advance together)", _ROUND_BUCKETS)
+        self.restacks = Counter(
+            f"{p}_restack_total",
+            "bucket re-stacks: dispatches that rebuilt the stacked "
+            "device columns instead of reusing the steady-bucket carry "
+            "(lane churn, fresh data, or a first arrival)")
+        self._all = (self.dispatches, self.stacked, self.padded,
+                     self.solo, self.stack_width, self.buckets,
+                     self.dispatch_seconds, self.restacks)
+        registry().register("lanes", self.render)
+
+    def render(self) -> str:
+        return "".join(m.render() for m in self._all)
+
+
+_LANES: Optional[LaneMetrics] = None
+_LANES_LOCK = threading.Lock()
+
+
+def lane_metrics() -> LaneMetrics:
+    """The process-wide LaneMetrics singleton."""
+    global _LANES
+    if _LANES is None:
+        with _LANES_LOCK:
+            if _LANES is None:
+                _LANES = LaneMetrics()
+    return _LANES
+
+
 class StreamMetrics:
     """Streaming continuous-learning accounting (``xgbtpu_stream_*``,
     PIPELINE.md streaming section): batch ingest, micro-cycle

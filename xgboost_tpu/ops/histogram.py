@@ -66,7 +66,8 @@ def _pallas_hist_vmappable(n_node: int, n_bin: int, precision: str,
     identity is stable for jit caches."""
     from jax.custom_batching import custom_vmap
     from xgboost_tpu.ops.pallas_hist import (
-        build_level_histogram_pallas, build_level_histogram_pallas_batched)
+        build_level_histogram_pallas, build_level_histogram_pallas_batched,
+        build_level_histogram_pallas_lanes)
 
     @custom_vmap
     def hist(binned, gh, pos):
@@ -78,13 +79,18 @@ def _pallas_hist_vmappable(n_node: int, n_bin: int, precision: str,
     def _rule(axis_size, in_batched, binned, gh, pos):
         binned_b, gh_b, pos_b = in_batched
         if binned_b:
-            # batched bins: no one-hot sharing possible — map per example
-            bb = binned
+            # batched BINS = tenant lanes (gang-batched multi-tenant
+            # training): no one-hot sharing possible, but the lane
+            # kernel grid-packs L whole datasets into one launch with
+            # per-lane accumulators — bitwise equal per lane to the
+            # solo kernel (the stacked-vs-solo model byte contract)
             gg = gh if gh_b else jnp.broadcast_to(
                 gh, (axis_size,) + gh.shape)
             pp = pos if pos_b else jnp.broadcast_to(
                 pos, (axis_size,) + pos.shape)
-            out = jax.lax.map(lambda xs: hist(*xs), (bb, gg, pp))
+            out = build_level_histogram_pallas_lanes(
+                binned, gg, pp, n_node, n_bin, precision=precision,
+                interpret=interpret)
             return out, True
         gg = gh if gh_b else jnp.broadcast_to(gh, (axis_size,) + gh.shape)
         pp = pos if pos_b else jnp.broadcast_to(pos, (axis_size,) + pos.shape)
@@ -187,11 +193,15 @@ def _pallas_hist_pre_vmappable(n_node: int, n_bin: int, precision: str,
                 return x if b else jnp.broadcast_to(
                     x, (axis_size,) + x.shape)
             if in_batched[0]:
-                out = jax.lax.map(
-                    lambda xs: hist(*xs),
-                    (binned, bc(binned_t, in_batched[1]),
-                     bc(gh_in, in_batched[2]), bc(scale, in_batched[3]),
-                     bc(pos, in_batched[4])))
+                # batched bins = tenant lanes: the lane kernel rides
+                # the prepared operands straight through (per-lane
+                # int8 scales dequantize per lane after the launch)
+                out = ph._hist_pallas_lanes_pre(
+                    bc(binned_t, in_batched[1]),
+                    bc(gh_in, in_batched[2]),
+                    bc(scale, in_batched[3]), bc(pos, in_batched[4]),
+                    (binned.shape[1], binned.shape[2]), n_node, n_bin,
+                    precision, interpret, native=native)
                 return out, True
             out = ph._hist_pallas_batched_prequant(
                 binned, bc(gh_in, in_batched[2]),
@@ -212,10 +222,13 @@ def _pallas_hist_pre_vmappable(n_node: int, n_bin: int, precision: str,
                 return x if b else jnp.broadcast_to(
                     x, (axis_size,) + x.shape)
             if in_batched[0]:
-                out = jax.lax.map(
-                    lambda xs: hist(*xs),
-                    (binned, bc(binned_t, in_batched[1]),
-                     bc(gh_in, in_batched[2]), bc(pos, in_batched[3])))
+                # batched bins = tenant lanes (see the has_scale rule)
+                out = ph._hist_pallas_lanes_pre(
+                    bc(binned_t, in_batched[1]),
+                    bc(gh_in, in_batched[2]), None,
+                    bc(pos, in_batched[3]),
+                    (binned.shape[1], binned.shape[2]), n_node, n_bin,
+                    precision, interpret, native=native)
                 return out, True
             out = ph._hist_pallas_batched_prequant(
                 binned, bc(gh_in, in_batched[2]), None,

@@ -44,7 +44,8 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
-                 n_bin: int, m_pad: int, f_tile: int, precision_mode: str):
+                 n_bin: int, m_pad: int, f_tile: int, precision_mode: str,
+                 rpl: int):
     """One (node_tile, feature_tile, row_tile) grid step.
 
     binned_ref: (f_tile, R) u8|int32 bin ids, feature-major
@@ -54,6 +55,13 @@ def _hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
                 nodes of THIS node tile (grid dim 0) — deep levels
                 (n_node > m_pad) tile the node dim so the block never
                 outgrows VMEM.
+    rpl:        row tiles per accumulator block.  The solo call passes
+                its whole row-tile count (init fires once, at row tile
+                0); the LANE-stacked call (gang-batched multi-tenant
+                training, _hist_pallas_lanes_pre) packs L tenants'
+                rows end-to-end along the row grid with one output
+                block per (lane, node tile) — init fires at each
+                lane's first row tile.
 
     EVERY per-row operand keeps rows in the LANE dim: TPU arrays tile
     to (8, 128), so (N, 1)/(N, 2) operands are physically inflated
@@ -66,7 +74,7 @@ def _hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,
     m2 = 2 * m_pad
     m_base = pl.program_id(0) * m_pad  # first global node of this tile
 
-    @pl.when(pl.program_id(2) == 0)
+    @pl.when(pl.program_id(2) % rpl == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
@@ -237,7 +245,8 @@ def _hist_pallas_pre(binned_t, gh_in, scale, pos, nf, n_node: int,
 
     out_dtype = jnp.int32 if precision == "int8" else jnp.float32
     kernel = functools.partial(_hist_kernel, n_bin=n_bin, m_pad=m_pad,
-                               f_tile=f_tile, precision_mode=precision)
+                               f_tile=f_tile, precision_mode=precision,
+                               rpl=n_pad // r_tile)
     out = pl.pallas_call(
         kernel,
         grid=(n_m_tiles, f_pad // f_tile, n_pad // r_tile),
@@ -269,6 +278,97 @@ def _hist_pallas_pre(binned_t, gh_in, scale, pos, nf, n_node: int,
         # dequantize the exact int32 sums back to f32 cell values
         out = out.astype(jnp.float32) * (scale / 127.0)[None, None, None, :]
     return out
+
+
+def _hist_pallas_lanes_pre(binned_t, gh_in, scale, pos, nf, n_node: int,
+                           n_bin: int, precision: str, interpret: bool,
+                           native: bool = False) -> jax.Array:
+    """LANE-stacked kernel invocation: a leading axis L batches WHOLE
+    tenant datasets (gang-batched multi-tenant training — each lane has
+    its own bins, so the tree-batched kernel's shared one-hot does not
+    apply).  Lanes pack end-to-end along the ROW grid dimension at
+    per-lane n_pad granularity, and the output index map gives every
+    (lane, node tile) its own accumulator block: each lane's block sees
+    exactly the row-tile sequence (content, order, and tile grouping)
+    of that lane's solo :func:`_hist_pallas_pre` call, so per-lane
+    results are BITWISE identical to solo — including signed zeros —
+    in every precision mode.  One launch, L x the solo grid.
+
+    binned_t (L, f_pad, n_pad); gh_in (L, N, 2) f32|int32;
+    scale (L, 2) f32 in int8 mode else None; pos (L, N) int32.
+    Returns (L, n_node, F, B, 2) f32 — or (L, F, B, 2, n_node) when
+    ``native`` (n_node <= 64, as solo)."""
+    L = binned_t.shape[0]
+    N, F = nf
+    r_tile, f_tile, n_pad, f_pad = _tiling(N, F, n_bin)
+    m_pad = min(n_node, 64)
+    n_m_tiles = -(-n_node // m_pad)
+    rpl = n_pad // r_tile  # row tiles per lane == per accumulator block
+    pos_t = jnp.pad(pos.astype(jnp.int32), ((0, 0), (0, n_pad - N)),
+                    constant_values=-1).reshape(1, L * n_pad)
+    gh_t = jnp.pad(gh_in, ((0, 0), (0, n_pad - N), (0, 0)))
+    gh_t = gh_t.transpose(2, 0, 1).reshape(2, L * n_pad)
+    bt = binned_t.transpose(1, 0, 2).reshape(f_pad, L * n_pad)
+
+    out_dtype = jnp.int32 if precision == "int8" else jnp.float32
+    kernel = functools.partial(_hist_kernel, n_bin=n_bin, m_pad=m_pad,
+                               f_tile=f_tile, precision_mode=precision,
+                               rpl=rpl)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_m_tiles, f_pad // f_tile, L * rpl),
+        in_specs=[
+            pl.BlockSpec((f_tile, r_tile), lambda mi, fi, ri: (fi, ri)),
+            pl.BlockSpec((1, r_tile), lambda mi, fi, ri: (0, ri)),
+            pl.BlockSpec((2, r_tile), lambda mi, fi, ri: (0, ri)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, f_tile * n_bin, 2 * m_pad),
+            lambda mi, fi, ri: (ri // rpl * n_m_tiles + mi, fi, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (L * n_m_tiles, f_pad * n_bin, 2 * m_pad), out_dtype),
+        interpret=interpret,
+    )(bt, pos_t, gh_t)
+
+    out = out.reshape(L, n_m_tiles, f_pad, n_bin, 2, m_pad)
+    if native:
+        assert n_m_tiles == 1, "native layout needs a single node tile"
+        out = out.reshape(L, f_pad, n_bin, 2, m_pad)[:, :F, :, :, :n_node]
+        if precision == "int8":
+            out = (out.astype(jnp.float32)
+                   * (scale / 127.0)[:, None, None, :, None])
+        return out
+    out = out.transpose(0, 1, 5, 2, 3, 4).reshape(
+        L, n_m_tiles * m_pad, f_pad, n_bin, 2)
+    out = out[:, :n_node, :F, :, :]
+    if precision == "int8":
+        out = (out.astype(jnp.float32)
+               * (scale / 127.0)[:, None, None, None, :])
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_node", "n_bin", "precision", "interpret"))
+def build_level_histogram_pallas_lanes(binned: jax.Array, gh: jax.Array,
+                                       pos: jax.Array, n_node: int,
+                                       n_bin: int, precision: str = "fp32",
+                                       interpret: bool = False) -> jax.Array:
+    """Lane-stacked histogram from RAW per-lane operands: binned
+    (L, N, F), gh (L, N, 2), pos (L, N) -> (L, n_node, F, B, 2) f32,
+    bitwise equal to stacking L solo
+    :func:`build_level_histogram_pallas` calls.  Selected by the
+    batched-bins branch of the histogram custom_vmap rules, i.e. by
+    ``jax.vmap`` over tenant lanes (gang-batched multi-tenant
+    training)."""
+    L, N, F = binned.shape
+    precision = resolve_precision(precision, N)
+    binned_t = jax.vmap(lambda b: transpose_bins(b, n_bin))(binned)
+    if precision == "int8":
+        gh_in, scale = quantize_gh(gh)               # per-lane (L, 2)
+    else:
+        gh_in, scale = gh.astype(jnp.float32), None
+    return _hist_pallas_lanes_pre(binned_t, gh_in, scale, pos, (N, F),
+                                  n_node, n_bin, precision, interpret)
 
 
 def _batched_hist_kernel(binned_ref, pos_ref, gh_ref, out_ref, *,

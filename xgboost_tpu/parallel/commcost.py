@@ -94,20 +94,35 @@ def hist_psum_bytes(max_depth: int, n_feat: int, n_bin: int,
             for d in range(max_depth)}
 
 
+_ROUND_MODEL_CACHE: Optional[tuple] = None  # (mtime_ns or None, model)
+
+
 def fitted_round_model() -> Optional[dict]:
     """The measured compute model from ``ROUND_MODEL.json`` (written by
     ``tools/fit_round_model.py`` from a single-chip row sweep at the
     bench config), or None if no fit has been recorded.  Fields:
     ``fixed_round_s`` (per-round launch/levels overhead — the
-    row-count-independent intercept) and ``per_row_s`` (the slope)."""
+    row-count-independent intercept) and ``per_row_s`` (the slope).
+    Cached by file mtime: auto rounds-per-dispatch sizing consults this
+    on EVERY fused segment plan (64 tenant lanes ask 64 times a cycle),
+    and a json parse per ask is measurable host overhead."""
+    global _ROUND_MODEL_CACHE
     path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), "ROUND_MODEL.json")
-    if not os.path.exists(path):
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        mtime = None
+    if _ROUND_MODEL_CACHE is not None and _ROUND_MODEL_CACHE[0] == mtime:
+        return _ROUND_MODEL_CACHE[1]
+    if mtime is None:
+        _ROUND_MODEL_CACHE = (None, None)
         return None
     try:
         with open(path) as f:
             m = json.load(f)
         float(m["fixed_round_s"]), float(m["per_row_s"])
+        _ROUND_MODEL_CACHE = (mtime, m)
         return m
     except Exception as e:
         # a torn/hand-edited fit file falls back to the analytic model;

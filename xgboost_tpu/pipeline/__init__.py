@@ -42,13 +42,19 @@ def run_pipeline(publish_path: str, workdir: str = "./pipeline",
                  sleep_sec: float = 0.0,
                  params: Optional[dict] = None,
                  source: Optional[DataSource] = None,
-                 quiet: bool = False, lane: str = "") -> dict:
+                 quiet: bool = False, lane: str = "",
+                 trainer_cls=None) -> dict:
     """Assemble the default pipeline from flat knob values (the CLI
     ``task=pipeline`` surface — every ``PIPELINE_PARAMS`` key maps to
     one argument) and run it.  ``source`` overrides the file seam for
     embedders.  ``lane`` names the catalog tenant this pipeline trains:
-    its events are lane-tagged and a router publish is scoped to that
-    model's hosting replicas (per-tenant rollout)."""
+    its events are lane-tagged, a router publish is scoped to that
+    model's hosting replicas (per-tenant rollout), and — unless params
+    pin one — the booster seed derives from the lane NAME, so a
+    tenant's model bytes never depend on which neighbors it shares a
+    process (or a gang-batched stack) with.  ``trainer_cls`` swaps the
+    trainer implementation (the gang-batched lane driver passes a
+    :class:`~xgboost_tpu.pipeline.lanes.GangTrainer` factory)."""
     if not publish_path:
         raise ValueError("pipeline_publish_path is required")
     if source is None:
@@ -57,20 +63,27 @@ def run_pipeline(publish_path: str, workdir: str = "./pipeline",
                 "pipeline_data and pipeline_holdout are required "
                 "(or pass a custom DataSource)")
         source = FileDataSource(data, holdout)
+    if lane and "seed" not in (params or {}):
+        import zlib
+        params = dict(params or {})
+        params["seed"] = zlib.crc32(lane.encode("utf-8")) & 0x7FFFFFFF
     gate = EvalGate(metric=metric, min_delta=min_delta,
                     max_regression=max_regression)
     publisher = (RolloutPublisher(publish_path, router_url,
                                   timeout=publish_timeout_sec,
                                   model=lane)
                  if router_url else Publisher(publish_path))
-    trainer = ContinuousTrainer(
+    trainer = (trainer_cls or ContinuousTrainer)(
         publish_path, source, workdir,
         rounds_per_cycle=rounds_per_cycle, params=params, gate=gate,
         publisher=publisher, quiet=quiet, lane=lane)
     return trainer.run(cycles=cycles, sleep_sec=sleep_sec)
 
 
-def run_tenant_lanes(lanes: dict, quiet: bool = False) -> dict:
+def run_tenant_lanes(lanes: dict, quiet: bool = False,
+                     max_workers: Optional[int] = None,
+                     stacked: Optional[bool] = None,
+                     window_sec: float = 0.2) -> dict:
     """Run one training lane per catalog tenant, concurrently.
 
     ``lanes`` maps a tenant/model name to a :func:`run_pipeline` kwargs
@@ -82,28 +95,66 @@ def run_tenant_lanes(lanes: dict, quiet: bool = False) -> dict:
     contract holds PER TENANT.  Lanes are isolated: one lane raising
     (or gate-failing forever) never stalls or poisons its neighbors —
     the error is contained in that lane's summary entry.
+
+    Two execution modes, byte-identical per tenant:
+
+    - **stacked** (default): same-shape lanes gang-batch their boosting
+      rounds into ONE vmapped device dispatch per round segment
+      (:mod:`xgboost_tpu.pipeline.lanes`); gate/publish/ledger fan-out
+      stays host-side per lane.  ``XGBTPU_LANE_STACK=0`` (or
+      ``stacked=False``) forces the host loop — the A/B baseline.
+    - **host loop**: each lane is a fully independent pipeline run,
+      bounded to ``max_workers`` concurrent lanes (default
+      ``min(len(lanes), 8)``).
     """
+    import os
     import threading
 
     from xgboost_tpu.obs import event
+
+    if stacked is None:
+        stacked = os.environ.get("XGBTPU_LANE_STACK", "1") not in ("0",)
+    if stacked:
+        from xgboost_tpu.pipeline.lanes import run_tenant_lanes_stacked
+        return run_tenant_lanes_stacked(lanes, quiet=quiet,
+                                        window_sec=window_sec,
+                                        max_workers=max_workers)
+
     results: dict = {}
+    rlock = threading.Lock()
+    if max_workers is None:
+        max_workers = min(len(lanes), 8)
+    max_workers = max(1, min(int(max_workers), len(lanes))) if lanes else 0
 
     def _one(name: str, kw: dict) -> None:
         kw = dict(kw)
         kw.setdefault("lane", name)
         kw.setdefault("quiet", quiet)
         try:
-            results[name] = {"status": "ok",
-                             "summary": run_pipeline(**kw)}
+            summary = run_pipeline(**kw)
+            with rlock:
+                results[name] = {"status": "ok", "summary": summary}
         except Exception as e:  # lane isolation: never kill siblings
-            results[name] = {"status": "error",
-                             "error": f"{type(e).__name__}: {e}"}
+            with rlock:
+                results[name] = {"status": "error",
+                                 "error": f"{type(e).__name__}: {e}"}
             event("pipeline.lane_error", lane=name,
                   error=f"{type(e).__name__}: {e}")
 
-    threads = [threading.Thread(target=_one, args=(name, kw),
-                                name=f"lane-{name}", daemon=True)
-               for name, kw in lanes.items()]
+    pending = list(lanes)
+    plock = threading.Lock()
+
+    def _worker() -> None:
+        while True:
+            with plock:
+                if not pending:
+                    return
+                name = pending.pop(0)
+            _one(name, lanes[name])
+
+    threads = [threading.Thread(target=_worker, name=f"lane-worker-{i}",
+                                daemon=True)
+               for i in range(max_workers)]
     for t in threads:
         t.start()
     for t in threads:

@@ -187,6 +187,16 @@ class ContinuousTrainer:
         stream trainer reapplies drift state (cut rebinds, EMA-FS
         feature screens) that model bytes alone do not carry."""
 
+    def _boost_rounds(self, bst, dtrain, it0: int, n_rounds: int,
+                      segment_callback) -> None:
+        """The cycle's boosting call — the one seam the gang-batched
+        lane driver (pipeline/lanes.py) overrides to route rounds
+        through a shared multi-tenant dispatch instead of this
+        booster's own ``update_many``.  Everything around it (resume,
+        gate, publish, ledger) stays per-tenant and host-side."""
+        bst.update_many(dtrain, it0, n_rounds,
+                        segment_callback=segment_callback)
+
     def _train(self, cycle: int, st: dict) -> Optional[str]:
         """Train the cycle's candidate; returns its path, or None when
         the source has no fresh data yet."""
@@ -241,9 +251,9 @@ class ContinuousTrainer:
                     _save_checkpoint(self.ckpt_dir, bst,
                                      last_i + 1 - base)
 
-                bst.update_many(dtrain, it0,
-                                self.rounds_per_cycle - appended,
-                                segment_callback=seg_cb)
+                self._boost_rounds(bst, dtrain, it0,
+                                   self.rounds_per_cycle - appended,
+                                   seg_cb)
             bst.save_model(self.candidate_path)  # atomic + CRC
         self._write_state({"cycle": cycle, "phase": "gate"})
         return self.candidate_path
